@@ -1,0 +1,20 @@
+"""llmlb_tpu — a TPU-native LLM serving gateway.
+
+A brand-new framework with the capabilities of akiojin/llmlb (an OpenAI-compatible
+LLM gateway / load balancer; see SURVEY.md): OpenAI + Anthropic API surface, TPS-EMA
+load balancing across endpoints, pull-based health checking, model sync, auth, audit
+chain, dashboard — plus a first-class in-tree ``tpu://`` endpoint type: a JAX/XLA
+continuous-batching inference engine (prefill/decode split, paged KV cache in HBM,
+pjit/shard_map tensor parallelism over ICI meshes).
+
+Layout:
+    llmlb_tpu.models    — functional JAX model families (Llama/Qwen/Mistral, ...)
+    llmlb_tpu.ops       — core TPU ops (attention incl. paged, RoPE, norms, sampling)
+    llmlb_tpu.parallel  — mesh construction + sharding rules (tp/dp/sp/ep)
+    llmlb_tpu.engine    — continuous-batching TPU inference engine + its HTTP server
+    llmlb_tpu.gateway   — the load-balancer gateway (API, balancer, registry, health,
+                          auth, audit, db, events, update)
+    llmlb_tpu.native    — ctypes bindings to the C++ native components (native/)
+"""
+
+__version__ = "0.1.0"

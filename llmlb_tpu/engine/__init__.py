@@ -1,0 +1,7 @@
+"""The in-tree `tpu://` inference engine (BASELINE.json north star).
+
+A JAX/XLA continuous-batching server: prefill/decode-split scheduler over a
+slot-based KV cache in HBM, tensor-parallel over an ICI mesh, exposing the same
+endpoint contract the gateway expects from any runtime (`/v1/models`,
+`/v1/chat/completions`, `/v1/responses`, `/api/health` with chip/HBM telemetry).
+"""

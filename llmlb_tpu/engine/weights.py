@@ -1,0 +1,104 @@
+"""Checkpoint ingestion: HF-format safetensors → sharded device arrays.
+
+TPU-native equivalent of the reference's `poc/nemotron-safetensors-cpp` probe
+(SURVEY.md §2.3 item 2): instead of just mmapping and reporting tensors, we map
+HF names onto the model pytree, transpose to our [in, out] matmul layout, stack
+layers for `lax.scan`, and `jax.device_put` each leaf with its NamedSharding so
+every host touches only its shard. A C++ mmap reader (native/) accelerates the
+host-side read path; `safetensors.numpy` is the portable fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Mapping
+
+import jax
+import numpy as np
+
+from llmlb_tpu.models.llama import LlamaConfig, Params, param_shardings
+
+TensorGetter = Callable[[str], np.ndarray]
+
+
+def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
+    """Map HF llama/qwen2/mistral tensor names to our stacked pytree (numpy)."""
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        leaves = []
+        for i in range(cfg.num_layers):
+            w = get(fmt.format(i=i))
+            leaves.append(w.T if transpose else w)
+        return np.stack(leaves)
+
+    params: dict = {
+        "embed": get("model.embed_tokens.weight"),
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
+        "wg": stack("model.layers.{i}.mlp.gate_proj.weight", True),
+        "wu": stack("model.layers.{i}.mlp.up_proj.weight", True),
+        "wd": stack("model.layers.{i}.mlp.down_proj.weight", True),
+        "ln_attn": stack("model.layers.{i}.input_layernorm.weight", False),
+        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight", False),
+        "ln_final": get("model.norm.weight"),
+    }
+    if cfg.attention_bias:
+        params["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
+        params["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
+        params["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", False)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+    return params
+
+
+def _safetensors_getter(model_dir: str) -> TensorGetter:
+    """Build a name→tensor getter over all *.safetensors shards in a directory."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    name_to_file: dict[str, str] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            name_to_file = json.load(f)["weight_map"]
+    else:
+        for fname in sorted(os.listdir(model_dir)):
+            if fname.endswith(".safetensors"):
+                with safe_open(os.path.join(model_dir, fname), framework="numpy") as sf:
+                    for name in sf.keys():
+                        name_to_file[name] = fname
+    handles: dict[str, object] = {}
+
+    def get(name: str) -> np.ndarray:
+        fname = name_to_file[name]
+        if fname not in handles:
+            handles[fname] = safe_open(
+                os.path.join(model_dir, fname), framework="numpy"
+            )
+        return handles[fname].get_tensor(name)
+
+    return get
+
+
+def load_config(model_dir: str, dtype=None) -> LlamaConfig:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    kwargs = {} if dtype is None else {"dtype": dtype}
+    return LlamaConfig.from_hf_config(hf, **kwargs)
+
+
+def load_checkpoint(model_dir: str, cfg: LlamaConfig, mesh=None) -> Params:
+    """Load a HF checkpoint directory into (optionally sharded) device arrays."""
+    get = _safetensors_getter(model_dir)
+    host_params = convert_hf_tensors(cfg, get)
+    if mesh is None:
+        return jax.tree.map(
+            lambda x: jax.numpy.asarray(x, dtype=cfg.dtype), host_params
+        )
+    shardings = param_shardings(cfg, mesh)
+    return {
+        name: jax.device_put(np.asarray(v, dtype=np.dtype(cfg.dtype)), shardings[name])
+        for name, v in host_params.items()
+    }

@@ -1,0 +1,19 @@
+from llmlb_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    param_shardings,
+    kv_cache_shardings,
+    init_kv_cache,
+    prefill,
+    decode_step,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "param_shardings",
+    "kv_cache_shardings",
+    "init_kv_cache",
+    "prefill",
+    "decode_step",
+]

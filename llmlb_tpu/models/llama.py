@@ -1,0 +1,318 @@
+"""Llama-family decoder (Llama-2/3, Qwen-2/2.5, Mistral) — functional JAX.
+
+TPU-first design decisions:
+- Pure functions over a flat param pytree; no Module framework. Everything jits.
+- All layers are *stacked* along a leading axis and iterated with `lax.scan`:
+  one layer gets compiled once, not num_layers times — compile time stays flat
+  even for 80-layer configs.
+- Serving-shaped entry points: `prefill` (bucketed [B, T] prompts into fresh KV
+  slots) and `decode_step` ([B] one token per slot). Both have fully static
+  shapes; raggedness is carried by `prompt_lens` / `seq_lens` masks.
+- Sharding is expressed once in `param_shardings` / `kv_cache_shardings` using
+  logical axes (parallel/sharding.py) — Megatron-style tp over heads/ffn/vocab,
+  dp over the batch/slot axis.
+
+The reference does no inference in-process (SURVEY.md L0: external runtimes over
+HTTP); this model family is the in-tree `tpu://` engine's compute core per the
+BASELINE.json north star. HF-format checkpoints load via engine/weights.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from llmlb_tpu.ops.attention import gqa_attention_decode, gqa_attention_prefill
+from llmlb_tpu.ops.norms import rms_norm
+from llmlb_tpu.ops.rope import RopeScaling, apply_rope, rope_frequencies
+from llmlb_tpu.parallel.mesh import validate_tp
+from llmlb_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int | None = None  # default hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None
+    rms_eps: float = 1e-5
+    attention_bias: bool = False  # Qwen-2/2.5 use qkv bias
+    tie_word_embeddings: bool = False
+    max_position_embeddings: int = 8192
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, dtype=jnp.bfloat16) -> "LlamaConfig":
+        """Build from a HF `config.json` dict (llama / qwen2 / mistral archs)."""
+        scaling = None
+        rs = hf.get("rope_scaling")
+        rope_type = rs.get("rope_type", rs.get("type")) if rs else None
+        if rope_type not in (None, "default", "llama3"):
+            raise NotImplementedError(
+                f"rope_scaling type {rope_type!r} is not supported yet; "
+                "refusing to load a checkpoint that would generate silently "
+                "wrong logits beyond its original context window"
+            )
+        if rope_type == "llama3":
+            scaling = RopeScaling(
+                factor=rs.get("factor", 8.0),
+                low_freq_factor=rs.get("low_freq_factor", 1.0),
+                high_freq_factor=rs.get("high_freq_factor", 4.0),
+                original_max_position=rs.get("original_max_position_embeddings", 8192),
+            )
+        model_type = hf.get("model_type", "llama")
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_scaling=scaling,
+            rms_eps=hf.get("rms_norm_eps", 1e-5),
+            attention_bias=hf.get(
+                "attention_bias", model_type in ("qwen2", "qwen2_moe")
+            ),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+            max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            dtype=dtype,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Random init (serving uses checkpoint weights; this backs tests/benches)."""
+    d = cfg.head_dim_
+    h, k_, e, f, l_ = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size, (
+        cfg.intermediate_size
+    ), cfg.num_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(
+            cfg.dtype
+        )
+
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, e), e),
+        "wq": w(next(keys), (l_, e, h * d), e),
+        "wk": w(next(keys), (l_, e, k_ * d), e),
+        "wv": w(next(keys), (l_, e, k_ * d), e),
+        "wo": w(next(keys), (l_, h * d, e), h * d),
+        "wg": w(next(keys), (l_, e, f), e),
+        "wu": w(next(keys), (l_, e, f), e),
+        "wd": w(next(keys), (l_, f, e), f),
+        "ln_attn": jnp.ones((l_, e), cfg.dtype),
+        "ln_mlp": jnp.ones((l_, e), cfg.dtype),
+        "ln_final": jnp.ones((e,), cfg.dtype),
+    }
+    if cfg.attention_bias:
+        params["bq"] = jnp.zeros((l_, h * d), cfg.dtype)
+        params["bk"] = jnp.zeros((l_, k_ * d), cfg.dtype)
+        params["bv"] = jnp.zeros((l_, k_ * d), cfg.dtype)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (e, cfg.vocab_size), e)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> dict[str, tuple]:
+    """Logical sharding axes per param leaf (see parallel/sharding.py)."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "wg": ("layers", "embed", "ffn"),
+        "wu": ("layers", "embed", "ffn"),
+        "wd": ("layers", "ffn", "embed"),
+        "ln_attn": ("layers", "embed"),
+        "ln_mlp": ("layers", "embed"),
+        "ln_final": ("embed",),
+    }
+    if cfg.attention_bias:
+        axes["bq"] = ("layers", "heads")
+        axes["bk"] = ("layers", "kv_heads")
+        axes["bv"] = ("layers", "kv_heads")
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def shard_rules_for(cfg: LlamaConfig, tp: int) -> ShardingRules:
+    """Default rules; kv heads replicate when tp exceeds the kv head count."""
+    validate_tp(cfg.num_heads, cfg.num_kv_heads, tp)
+    if cfg.intermediate_size % tp != 0:
+        raise ValueError(
+            f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}"
+        )
+    kv_shardable = cfg.num_kv_heads % tp == 0
+    return ShardingRules(kv_heads="tp" if kv_shardable else None)
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh, rules: ShardingRules | None = None):
+    rules = rules or shard_rules_for(cfg, mesh.shape["tp"])
+    return {
+        name: logical_to_sharding(mesh, rules, *axes)
+        for name, axes in param_logical_axes(cfg).items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# KV cache (slot-based: [L, B_slots, S_capacity, K, D])
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(
+    cfg: LlamaConfig, num_slots: int, capacity: int, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    shape = (cfg.num_layers, num_slots, capacity, cfg.num_kv_heads, cfg.head_dim_)
+    dtype = dtype or cfg.dtype
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh, rules: ShardingRules | None = None):
+    rules = rules or shard_rules_for(cfg, mesh.shape["tp"])
+    sharding = logical_to_sharding(
+        mesh, rules, "layers", "batch", "seq", "kv_heads", "head_dim"
+    )
+    return (sharding, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_stacked_names(cfg: LlamaConfig) -> list[str]:
+    names = ["wq", "wk", "wv", "wo", "wg", "wu", "wd", "ln_attn", "ln_mlp"]
+    if cfg.attention_bias:
+        names += ["bq", "bk", "bv"]
+    return names
+
+
+def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray):
+    b, t, _ = x.shape
+    d = cfg.head_dim_
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return (
+        q.reshape(b, t, cfg.num_heads, d),
+        k.reshape(b, t, cfg.num_kv_heads, d),
+        v.reshape(b, t, cfg.num_kv_heads, d),
+    )
+
+
+def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+
+
+def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["ln_final"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum(
+        "be,ev->bv", x, head, preferred_element_type=jnp.float32
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+def prefill(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [B] int32
+    cache_k: jnp.ndarray,  # [L, B, S, K, D] — fresh slots, written at [0:T]
+    cache_v: jnp.ndarray,
+):
+    """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
+    cache_k, cache_v)."""
+    b, t = input_ids.shape
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+
+    x = params["embed"][input_ids]  # [B, T, E]
+
+    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+
+    def layer(carry_x, layer_in):
+        lp, ck, cv = layer_in  # ck/cv: [B, S, K, D]
+        h = rms_norm(carry_x, lp["ln_attn"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        attn = gqa_attention_prefill(q, k, v, prompt_lens)
+        carry_x = carry_x + attn.reshape(b, t, -1) @ lp["wo"]
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + _mlp(lp, h)
+        return carry_x, (ck, cv)
+
+    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
+
+    last = jnp.maximum(prompt_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
+    logits = _unembed(cfg, params, x_last)
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+def decode_step(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B] int32 — previous sampled token per slot
+    seq_lens: jnp.ndarray,  # [B] int32 — tokens already in cache (new token's position)
+    cache_k: jnp.ndarray,  # [L, B, S, K, D]
+    cache_v: jnp.ndarray,
+):
+    """One decode step across all slots. Returns (logits [B, V] fp32, caches)."""
+    b = input_ids.shape[0]
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    positions = seq_lens[:, None]  # [B, 1]
+    batch_idx = jnp.arange(b)
+
+    x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
+    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+
+    def layer(carry_x, layer_in):
+        lp, ck, cv = layer_in
+        h = rms_norm(carry_x, lp["ln_attn"], cfg.rms_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        ck = ck.at[batch_idx, seq_lens].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[batch_idx, seq_lens].set(v[:, 0].astype(cv.dtype))
+        attn = gqa_attention_decode(q, ck, cv, seq_lens + 1)
+        carry_x = carry_x + attn.reshape(b, 1, -1) @ lp["wo"]
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + _mlp(lp, h)
+        return carry_x, (ck, cv)
+
+    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
+    logits = _unembed(cfg, params, x[:, 0])
+    return logits, cache_k, cache_v

@@ -1,0 +1,16 @@
+from llmlb_tpu.ops.norms import rms_norm
+from llmlb_tpu.ops.rope import apply_rope, rope_frequencies
+from llmlb_tpu.ops.attention import (
+    gqa_attention_prefill,
+    gqa_attention_decode,
+)
+from llmlb_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "gqa_attention_prefill",
+    "gqa_attention_decode",
+    "sample_tokens",
+]

@@ -1,0 +1,88 @@
+"""Grouped-query attention for serving: batched prefill + single-token decode.
+
+Design notes (TPU-first):
+- Static shapes everywhere: prefill runs at bucketed sequence lengths, decode at
+  T=1 over a fixed-capacity per-slot KV cache. Ragged reality is expressed with
+  masks, not dynamic shapes, so XLA tiles everything onto the MXU.
+- Softmax in float32; QK^T and PV in bf16 inputs with fp32 accumulation
+  (`preferred_element_type`) — the MXU accumulates in fp32 natively.
+- GQA is expressed by folding the group dimension into einsum so no materialized
+  `repeat_kv` copy hits HBM.
+
+The reference gateway never touches attention (it proxies; SURVEY.md §5
+"long-context: absent") — this op family is new TPU-native design. A Pallas ragged
+paged attention kernel (PAPERS.md) replaces the dense decode path in a later phase;
+this XLA version is the correctness baseline it is checked against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large finite value: -inf breaks softmax rows that are fully masked
+
+
+def _split_gqa(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, T, H, D] -> [B, T, K, G, D] where H = K * G."""
+    b, t, h, d = q.shape
+    return q.reshape(b, t, num_kv_heads, h // num_kv_heads, d)
+
+
+def gqa_attention_prefill(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, K, D]
+    v: jnp.ndarray,  # [B, T, K, D]
+    prompt_lens: jnp.ndarray,  # [B] int32 — tokens beyond this are padding
+) -> jnp.ndarray:
+    """Causal self-attention over a freshly-prefilled prompt. Returns [B, T, H, D]."""
+    b, t, h, d = q.shape
+    k_heads = k.shape[2]
+    qg = _split_gqa(q, k_heads)
+    scale = d**-0.5
+
+    # [B, K, G, Tq, Tk] fp32 scores
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+
+    pos = jnp.arange(t, dtype=jnp.int32)
+    causal = pos[:, None] >= pos[None, :]  # [Tq, Tk]
+    valid = pos[None, :] < prompt_lens[:, None, None]  # broadcast to [B, 1, Tk]
+    mask = causal[None, :, :] & valid  # [B, Tq, Tk]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def gqa_attention_decode(
+    q: jnp.ndarray,  # [B, 1, H, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D] — slot-capacity cache incl. current token
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    kv_lens: jnp.ndarray,  # [B] int32 — valid cache length per slot (incl. current)
+) -> jnp.ndarray:
+    """One-token decode attention against the full slot cache. Returns [B, 1, H, D]."""
+    b, t, h, d = q.shape
+    k_heads = k_cache.shape[2]
+    qg = _split_gqa(q, k_heads)  # [B, 1, K, G, D]
+    scale = d**-0.5
+
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale  # [B, K, G, 1, S]
+
+    s = k_cache.shape[1]
+    valid = jnp.arange(s, dtype=jnp.int32)[None, :] < kv_lens[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, None, :], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs.astype(q.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, h, d).astype(q.dtype)

@@ -1,0 +1,15 @@
+"""Normalization ops. Computed in float32, cast back — bf16 accumulate drifts."""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm: x * w / rms(x). Keeps the VPU in fp32 for the reduction."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,62 @@
+"""Rotary position embeddings (interleaved-half convention, HF-compatible).
+
+Supports plain RoPE (Llama-2/Qwen/Mistral) and Llama-3 frequency scaling.
+Frequencies are computed from integer positions at trace time — no precomputed
+table in HBM, XLA fuses the sin/cos into the surrounding elementwise graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3-style rope scaling (factor-based NTK with wavelength thresholds)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
+) -> jnp.ndarray:
+    """Per-pair inverse frequencies, shape [head_dim // 2], float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**exponents)
+    if scaling is not None:
+        low_wl = scaling.original_max_position / scaling.low_freq_factor
+        high_wl = scaling.original_max_position / scaling.high_freq_factor
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / scaling.factor
+        blended = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wl, scaled, jnp.where(wavelen < high_wl, inv_freq, blended)
+        )
+    return inv_freq
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [B, T, H, D]
+    positions: jnp.ndarray,  # [B, T] int32
+    inv_freq: jnp.ndarray,  # [D // 2]
+) -> jnp.ndarray:
+    """Rotate q or k by position. Split-half (rotate_half) layout, as HF Llama."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rotated = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
+    return rotated.astype(x.dtype)

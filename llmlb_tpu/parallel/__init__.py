@@ -1,0 +1,4 @@
+from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh
+from llmlb_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+
+__all__ = ["MeshConfig", "build_mesh", "ShardingRules", "logical_to_sharding"]

@@ -1,0 +1,82 @@
+"""Device mesh construction for the TPU engine.
+
+The engine scales via a named `jax.sharding.Mesh` with axes:
+
+    dp — data parallel (replica batches; gradient-free serving means pure request DP)
+    tp — tensor parallel (Megatron-style sharding of attention heads / MLP widths,
+         rides ICI within a slice)
+
+The reference gateway has no intra-model parallelism at all (SURVEY.md §2.4) — its
+only parallelism is request-level routing across endpoints. Model parallelism is a
+new, first-class component of the TPU build (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of the mesh axes. -1 means "use all remaining devices"."""
+
+    dp: int = 1
+    tp: int = -1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        dp, tp = self.dp, self.tp
+        if tp == -1 and dp == -1:
+            raise ValueError("at most one mesh axis may be -1")
+        if tp == -1:
+            tp = n_devices // dp
+        if dp == -1:
+            dp = n_devices // tp
+        if dp * tp != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{tp} does not cover {n_devices} devices"
+            )
+        return MeshConfig(dp=dp, tp=tp)
+
+
+def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh over the given devices (default: all devices).
+
+    Device order matters on TPU: `jax.devices()` enumerates in ICI-topology order,
+    so adjacent tp ranks are ICI neighbours and tp collectives (the latency-critical
+    ones in tensor-parallel decode) stay on-chip-interconnect rather than DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or MeshConfig()).resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(config.dp, config.tp)
+    return Mesh(dev_array, axis_names=("dp", "tp"))
+
+
+def largest_pow2_tp(n_devices: int, num_kv_heads: int) -> int:
+    """Largest power-of-two tp degree that divides both devices and kv heads."""
+    tp = 1
+    while (
+        tp * 2 <= n_devices
+        and n_devices % (tp * 2) == 0
+        and num_kv_heads % (tp * 2) == 0
+    ):
+        tp *= 2
+    return tp
+
+
+def is_power_of_two(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def validate_tp(num_heads: int, num_kv_heads: int, tp: int) -> None:
+    if num_heads % tp != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by tp={tp}")
+    if num_kv_heads % tp != 0 and tp % num_kv_heads != 0:
+        raise ValueError(
+            f"num_kv_heads={num_kv_heads} incompatible with tp={tp}: "
+            "need kv_heads % tp == 0 (sharded) or tp % kv_heads == 0 (replicated)"
+        )

@@ -1,0 +1,48 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+Params and activations are annotated with *logical* axis names; `ShardingRules`
+maps them onto mesh axes. This keeps model code mesh-agnostic: the same forward
+runs on 1 chip (all rules → None) or a v5e-8 (tp rules active) without edits.
+The reference has no model parallelism at all (SURVEY.md §2.4); this is new,
+TPU-first design per the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Maps logical axis names to mesh axis names (or None = replicated)."""
+
+    batch: str | None = "dp"
+    # attention heads / MLP hidden width — the Megatron tp axis
+    heads: str | None = "tp"
+    kv_heads: str | None = "tp"
+    ffn: str | None = "tp"
+    vocab: str | None = "tp"
+    # residual-stream model dim: replicated (activations all-reduced after tp matmuls)
+    embed: str | None = None
+    head_dim: str | None = None
+    seq: str | None = None
+    layers: str | None = None
+
+    def spec(self, *logical_axes: str | None) -> P:
+        return P(*(getattr(self, ax) if ax is not None else None for ax in logical_axes))
+
+
+def logical_to_sharding(
+    mesh: Mesh, rules: ShardingRules, *logical_axes: str | None
+) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(*logical_axes))
+
+
+def constrain(x: jax.Array, mesh: Mesh, rules: ShardingRules, *logical_axes):
+    """with_sharding_constraint by logical axes; no-op outside a mesh context."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, rules.spec(*logical_axes))
+    )
