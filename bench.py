@@ -1,0 +1,94 @@
+"""Headline bench: continuous-decode throughput, tokens/sec/chip.
+
+Runs the 1B-class bench model (random weights — checkpoint download is not
+available in the bench environment) with a full decode batch and measures
+sustained decode throughput per chip, the BASELINE.md "tokens/sec/chip" target
+(the reference publishes no model-serving numbers; `vs_baseline` is measured
+against A100_CLASS_TOKS_PER_SEC, a vLLM-on-A100-class per-chip decode rate for
+1B-class models, per the BASELINE.json north-star framing).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Stand-in baseline: per-chip decode throughput of a 1B-class model on a
+# vLLM/A100-class serving stack at batch 32 (public figures cluster ~2-3k tok/s
+# per accelerator for 1B models; we take the high end as the bar to beat).
+A100_CLASS_TOKS_PER_SEC = 3000.0
+
+BATCH = 32
+CAPACITY = 1024
+PREFILL_LEN = 128
+DECODE_STEPS = 64
+WARMUP_STEPS = 8
+
+
+def main() -> None:
+    from llmlb_tpu.engine.presets import get_preset
+    from llmlb_tpu.models.llama import (
+        decode_step,
+        init_kv_cache,
+        init_params,
+        prefill,
+    )
+    from llmlb_tpu.ops.sampling import sample_tokens
+
+    n_chips = len(jax.devices())
+    cfg = get_preset("tinyllama-1.1b")
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ck, cv = init_kv_cache(cfg, BATCH, CAPACITY)
+
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH, PREFILL_LEN), 0, cfg.vocab_size
+    )
+    lens = jnp.full((BATCH,), PREFILL_LEN, jnp.int32)
+    logits, ck, cv = prefill(params, cfg, ids, lens, ck, cv)
+
+    temp = jnp.full((BATCH,), 0.7, jnp.float32)
+    top_p = jnp.full((BATCH,), 0.95, jnp.float32)
+    top_k = jnp.zeros((BATCH,), jnp.int32)
+    key = jax.random.PRNGKey(2)
+
+    def step(carry):
+        logits, ck, cv, seq_lens, key = carry
+        key, sk = jax.random.split(key)
+        tokens = sample_tokens(logits, sk, temp, top_p, top_k)
+        logits, ck, cv = decode_step(params, cfg, tokens, seq_lens, ck, cv)
+        return logits, ck, cv, seq_lens + 1, key
+
+    carry = (logits, ck, cv, lens, key)
+    for _ in range(WARMUP_STEPS):
+        carry = step(carry)
+    carry[0].block_until_ready()
+
+    start = time.perf_counter()
+    for _ in range(DECODE_STEPS):
+        carry = step(carry)
+    carry[0].block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    toks_per_sec = BATCH * DECODE_STEPS / elapsed
+    per_chip = toks_per_sec / max(n_chips, 1)
+    print(
+        json.dumps(
+            {
+                "metric": "decode_tokens_per_sec_per_chip_1b_bf16_batch32",
+                "value": round(per_chip, 2),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(per_chip / A100_CLASS_TOKS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

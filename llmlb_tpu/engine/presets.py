@@ -1,0 +1,58 @@
+"""Named model presets for the tpu:// engine.
+
+Presets let the engine start without a checkpoint directory (random weights) for
+benches/tests, and pin the architectural config for well-known checkpoints so
+serving starts before config.json is even read. Shapes follow the public model
+cards; none of this data comes from the reference repo (which stores only
+name→engine alias mappings, /root/reference/llmlb/src/models/mapping.rs).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from llmlb_tpu.models.llama import LlamaConfig
+from llmlb_tpu.ops.rope import RopeScaling
+
+PRESETS: dict[str, LlamaConfig] = {
+    # flagship serving target (BASELINE.json config #2)
+    "llama-3-8b": LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+        rms_eps=1e-5, max_position_embeddings=8192,
+    ),
+    "llama-3.1-8b": LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+        rope_scaling=RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0, original_max_position=8192),
+        rms_eps=1e-5, max_position_embeddings=131072,
+    ),
+    # 1B-class: fits one v5e chip with headroom; the single-chip bench model
+    "tinyllama-1.1b": LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=32, num_kv_heads=4, rope_theta=10000.0,
+        rms_eps=1e-5, max_position_embeddings=2048,
+    ),
+    "qwen2.5-0.5b": LlamaConfig(
+        vocab_size=151936, hidden_size=896, intermediate_size=4864,
+        num_layers=24, num_heads=14, num_kv_heads=2, rope_theta=1000000.0,
+        rms_eps=1e-6, attention_bias=True, tie_word_embeddings=True,
+        max_position_embeddings=32768,
+    ),
+    # CI-sized config for unit tests and the multichip dry-run
+    "debug-tiny": LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=8, num_kv_heads=4, dtype=jnp.float32,
+        max_position_embeddings=128,
+    ),
+}
+
+
+def get_preset(name: str) -> LlamaConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model preset {name!r}; available: {sorted(PRESETS)}"
+        ) from None

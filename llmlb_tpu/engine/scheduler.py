@@ -1,0 +1,352 @@
+"""Continuous-batching scheduler: prefill/decode split over a slot cache.
+
+JetStream-style serving loop, TPU-first:
+- A fixed pool of NUM_SLOTS decode slots backed by one static-shape KV cache
+  [L, NUM_SLOTS, CAP, K, D] living in HBM. One compiled `decode_step` serves
+  every mix of requests — raggedness is masks, never shapes.
+- New requests prefill one at a time at bucketed prompt lengths (pow2 buckets ⇒
+  a handful of compiles) and scatter straight into a free slot row
+  (`prefill_into_slots`), while other slots keep decoding between prefills.
+- Sampling params live in device arrays indexed by slot; updated on insert.
+- The step loop runs in a dedicated thread; completions stream to waiters
+  through per-request queues (asyncio- and thread-friendly).
+
+The reference has no equivalent (it proxies to external runtimes, SURVEY.md L0);
+this is the in-tree `tpu://` engine of the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmlb_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    decode_step,
+    init_kv_cache,
+    kv_cache_shardings,
+    param_shardings,
+    prefill_into_slots,
+)
+from llmlb_tpu.ops.sampling import sample_tokens
+from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
+
+log = logging.getLogger("llmlb_tpu.engine")
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    max_tokens: int = 128
+
+
+@dataclasses.dataclass
+class Request:
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    request_id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    # events: ("token", token_id) ... ("done", finish_reason) | ("error", msg)
+    events: queue.SimpleQueue = dataclasses.field(default_factory=queue.SimpleQueue)
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    # Set by the consumer (stop hit / client gone); the step loop frees the slot
+    # at its next emit for this request. Plain bool write — atomic under the GIL.
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request | None = None
+    generated: int = 0
+    eos_id: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineStats:
+    num_slots: int
+    active_slots: int
+    queued: int
+    total_requests: int
+    total_tokens: int
+    uptime_s: float
+
+
+class EngineCore:
+    """The compute side of the engine: owns params, cache, and the step loop."""
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Params | None = None,
+        *,
+        num_slots: int = 8,
+        slot_capacity: int = 512,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
+        mesh_config: MeshConfig | None = None,
+        eos_id: int = -1,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.slot_capacity = min(slot_capacity, cfg.max_position_embeddings)
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= self.slot_capacity
+        )
+        self.eos_id = eos_id
+
+        devices = jax.devices()
+        if mesh_config is None:
+            tp = default_tp(len(devices), cfg.num_heads, cfg.num_kv_heads)
+            mesh_config = MeshConfig(dp=len(devices) // tp, tp=tp)
+        self.mesh = build_mesh(mesh_config, devices=devices)
+
+        if params is None:
+            from llmlb_tpu.models.llama import init_params
+
+            params = init_params(cfg, jax.random.PRNGKey(seed))
+        shardings = param_shardings(cfg, self.mesh)
+        self.params = {
+            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+        }
+
+        ck, cv = init_kv_cache(cfg, num_slots, self.slot_capacity)
+        ck_sh, cv_sh = kv_cache_shardings(cfg, self.mesh)
+        self.cache_k = jax.device_put(ck, ck_sh)
+        self.cache_v = jax.device_put(cv, cv_sh)
+
+        # Host-side slot bookkeeping; device-side mirrors rebuilt on change.
+        self.slots = [_Slot() for _ in range(num_slots)]
+        self._seq_lens = np.zeros((num_slots,), np.int32)
+        self._temps = np.ones((num_slots,), np.float32)
+        self._top_ps = np.ones((num_slots,), np.float32)
+        self._top_ks = np.zeros((num_slots,), np.int32)
+        self._last_tokens = np.zeros((num_slots,), np.int32)
+        self._key = jax.random.PRNGKey(seed)
+
+        self.pending: queue.SimpleQueue[Request] = queue.SimpleQueue()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._started_at = time.monotonic()
+        self.total_requests = 0
+        self.total_tokens = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ public
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="engine-step-loop", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    def submit(self, request: Request) -> Request:
+        max_prompt = self.prefill_buckets[-1] if self.prefill_buckets else 0
+        if len(request.prompt_ids) > max_prompt:
+            raise ValueError(
+                f"prompt of {len(request.prompt_ids)} tokens exceeds the "
+                f"largest prefill bucket ({max_prompt})"
+            )
+        with self._lock:
+            self.total_requests += 1
+        self.pending.put(request)
+        return request
+
+    def stats(self) -> EngineStats:
+        active = sum(1 for s in self.slots if s.request is not None)
+        return EngineStats(
+            num_slots=self.num_slots,
+            active_slots=active,
+            queued=self.pending.qsize(),
+            total_requests=self.total_requests,
+            total_tokens=self.total_tokens,
+            uptime_s=time.monotonic() - self._started_at,
+        )
+
+    # ------------------------------------------------------------------- loop
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no prefill bucket for prompt of {n} tokens")
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                return i
+        return None
+
+    def _loop(self) -> None:
+        while self._running:
+            did_work = False
+            try:
+                did_work |= self._try_insert()
+                did_work |= self._decode_active()
+            except Exception:  # pragma: no cover - defensive: fail loud, keep serving
+                log.exception("engine step failed; resetting engine state")
+                self._fail_all("engine step error")
+                # prefill/decode donate the caches: after a failed dispatch the
+                # buffers may already be consumed — rebuild before serving again.
+                self._reset_caches()
+            if not did_work:
+                time.sleep(0.001)
+
+    def _reset_caches(self) -> None:
+        ck, cv = init_kv_cache(self.cfg, self.num_slots, self.slot_capacity)
+        ck_sh, cv_sh = kv_cache_shardings(self.cfg, self.mesh)
+        self.cache_k = jax.device_put(ck, ck_sh)
+        self.cache_v = jax.device_put(cv, cv_sh)
+        self._seq_lens[:] = 0
+        self._last_tokens[:] = 0
+
+    def _try_insert(self) -> bool:
+        slot_id = self._free_slot()
+        if slot_id is None:
+            return False
+        try:
+            request = self.pending.get_nowait()
+        except queue.Empty:
+            return False
+        if request.cancelled:
+            request.events.put(("done", "cancelled"))
+            return True
+
+        n = len(request.prompt_ids)
+        # Cap generation so the slot cache can hold prompt + output.
+        room = self.slot_capacity - n - 1
+        if room <= 0:
+            request.events.put(("error", "prompt does not fit slot capacity"))
+            return True
+        bucket = self._bucket_for(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = request.prompt_ids
+
+        logits, self.cache_k, self.cache_v = prefill_into_slots(
+            self.params,
+            self.cfg,
+            jnp.asarray(ids),
+            jnp.asarray([n], np.int32),
+            jnp.asarray([slot_id], np.int32),
+            self.cache_k,
+            self.cache_v,
+        )
+
+        slot = self.slots[slot_id]
+        slot.request = request
+        slot.generated = 0
+        self._seq_lens[slot_id] = n
+        self._temps[slot_id] = request.sampling.temperature
+        self._top_ps[slot_id] = request.sampling.top_p
+        self._top_ks[slot_id] = request.sampling.top_k
+
+        # Sample the first token straight from the prefill logits.
+        self._key, sk = jax.random.split(self._key)
+        token = int(
+            np.asarray(
+                sample_tokens(
+                    logits,
+                    sk,
+                    jnp.asarray(self._temps[slot_id : slot_id + 1]),
+                    jnp.asarray(self._top_ps[slot_id : slot_id + 1]),
+                    jnp.asarray(self._top_ks[slot_id : slot_id + 1]),
+                )
+            )[0]
+        )
+        request.first_token_at = time.monotonic()
+        self._emit(slot_id, token)
+        return True
+
+    def _decode_active(self) -> bool:
+        active = [i for i, s in enumerate(self.slots) if s.request is not None]
+        if not active:
+            return False
+
+        self._key, sk = jax.random.split(self._key)
+        logits, self.cache_k, self.cache_v = decode_step(
+            self.params,
+            self.cfg,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._seq_lens),
+            self.cache_k,
+            self.cache_v,
+        )
+        tokens = np.asarray(
+            sample_tokens(
+                logits,
+                sk,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ps),
+                jnp.asarray(self._top_ks),
+            )
+        )
+        self._seq_lens[active] += 1
+        for i in active:
+            self._emit(i, int(tokens[i]))
+        return True
+
+    def _emit(self, slot_id: int, token: int) -> None:
+        slot = self.slots[slot_id]
+        request = slot.request
+        assert request is not None
+        if request.cancelled:
+            request.finished_at = time.monotonic()
+            request.events.put(("done", "cancelled"))
+            slot.request = None
+            slot.generated = 0
+            return
+        self._last_tokens[slot_id] = token
+        slot.generated += 1
+        with self._lock:
+            self.total_tokens += 1
+
+        finish: str | None = None
+        if token == self.eos_id:
+            finish = "stop"
+        elif slot.generated >= request.sampling.max_tokens:
+            finish = "length"
+        elif self._seq_lens[slot_id] + 1 >= self.slot_capacity:
+            finish = "length"
+
+        if finish == "stop":
+            pass  # EOS itself is not emitted as content
+        else:
+            request.events.put(("token", token))
+
+        if finish is not None:
+            request.finished_at = time.monotonic()
+            request.events.put(("done", finish))
+            slot.request = None
+            slot.generated = 0
+
+    def _fail_all(self, message: str) -> None:
+        for slot in self.slots:
+            if slot.request is not None:
+                slot.request.events.put(("error", message))
+                slot.request = None
+        while True:
+            try:
+                self.pending.get_nowait().events.put(("error", message))
+            except queue.Empty:
+                break

@@ -1,0 +1,492 @@
+"""The tpu:// endpoint HTTP server — the contract the gateway routes to.
+
+Implements the runtime-side API the reference gateway expects of any endpoint
+(SURVEY.md §7 stance): OpenAI `/v1/models`, `/v1/chat/completions`,
+`/v1/completions`, `/v1/responses` (SSE streams end with a usage-bearing
+payload — the gateway's TPS tracker depends on it, reference
+llmlb/src/api/proxy.rs:118-241), plus `/api/health` with TPU chip/HBM telemetry
+in place of the GPU fields (endpoint_checker.rs:515) and `/api/system` carrying
+the `tpu_engine` marker the gateway's type detection probes first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import time
+import uuid
+
+from aiohttp import web
+
+from llmlb_tpu import __version__
+from llmlb_tpu.engine.scheduler import SamplingParams
+from llmlb_tpu.engine.service import Engine, EngineError
+
+log = logging.getLogger("llmlb_tpu.engine.server")
+
+MAX_BODY_BYTES = 20 * 1024 * 1024  # parity: reference caps /v1/* at 20 MiB
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": None}},
+        status=status,
+    )
+
+
+def _sampling_from(body: dict, default_max: int = 256) -> SamplingParams:
+    def pick(*names, default):
+        for n in names:
+            if body.get(n) is not None:
+                return body[n]
+        return default
+
+    temperature = float(pick("temperature", default=1.0))
+    top_p = float(pick("top_p", default=1.0))
+    top_k = int(pick("top_k", default=0))
+    max_tokens = int(
+        pick("max_tokens", "max_completion_tokens", "max_output_tokens",
+             default=default_max)
+    )
+    if temperature < 0:
+        raise ValueError("'temperature' must be >= 0")
+    if not 0 < top_p <= 1:
+        raise ValueError("'top_p' must be in (0, 1]")
+    if top_k < 0:
+        raise ValueError("'top_k' must be >= 0")
+    if max_tokens < 1:
+        raise ValueError("'max_tokens' must be >= 1")
+    return SamplingParams(
+        temperature=temperature, top_p=top_p, top_k=top_k, max_tokens=max_tokens
+    )
+
+
+def _stops_from(body: dict) -> list[str]:
+    stop = body.get("stop") or body.get("stop_sequences") or []
+    if isinstance(stop, str):
+        return [stop]
+    return [s for s in stop if isinstance(s, str)]
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+async def _sse_send(resp: web.StreamResponse, payload: dict | str) -> None:
+    if isinstance(payload, str):
+        data = payload
+    else:
+        data = json.dumps(payload, separators=(",", ":"))
+    await resp.write(f"data: {data}\n\n".encode())
+
+
+class EngineAPI:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+
+    # ------------------------------------------------------------- inventory
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.engine.model_id,
+                        "object": "model",
+                        "created": 0,
+                        "owned_by": "llmlb_tpu",
+                    }
+                ],
+            }
+        )
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(self.engine.health())
+
+    async def system(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "name": "llmlb_tpu-engine",
+                "version": __version__,
+                "tpu_engine": True,
+                "model": self.engine.model_id,
+            }
+        )
+
+    # ------------------------------------------------------ chat completions
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return _error(400, "'messages' must be a non-empty array")
+        if int(body.get("n") or 1) != 1:
+            return _error(400, "only n=1 is supported")
+        model = body.get("model") or self.engine.model_id
+
+        try:
+            prompt_ids = self.engine.encode_chat(messages)
+        except Exception as e:
+            return _error(400, f"failed to encode messages: {e}")
+        sampling = _sampling_from(body)
+        stops = _stops_from(body)
+
+        completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        if body.get("stream"):
+            return await self._stream_chat(
+                request, completion_id, created, model, prompt_ids, sampling, stops,
+                include_usage=bool(
+                    (body.get("stream_options") or {}).get("include_usage", True)
+                ),
+            )
+
+        try:
+            result = await self.engine.complete(prompt_ids, sampling, stops)
+        except EngineError as e:
+            return _error(500, str(e), "server_error")
+        except ValueError as e:
+            return _error(400, str(e))
+        return web.json_response(
+            {
+                "id": completion_id,
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": result.text},
+                        "finish_reason": result.finish_reason,
+                    }
+                ],
+                "usage": _usage(result.prompt_tokens, result.completion_tokens),
+            }
+        )
+
+    async def _stream_chat(
+        self, request, completion_id, created, model, prompt_ids, sampling, stops,
+        include_usage: bool,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+
+        def chunk(delta: dict, finish: str | None = None) -> dict:
+            return {
+                "id": completion_id,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {"index": 0, "delta": delta, "finish_reason": finish}
+                ],
+            }
+
+        await _sse_send(resp, chunk({"role": "assistant", "content": ""}))
+        usage = _usage(len(prompt_ids), 0)
+        finish = "stop"
+        try:
+            async for delta in self.engine.stream(prompt_ids, sampling, stops):
+                if delta.text:
+                    await _sse_send(resp, chunk({"content": delta.text}))
+                if delta.finish_reason is not None:
+                    finish = delta.finish_reason
+                    usage = _usage(delta.prompt_tokens, delta.completion_tokens)
+        except (EngineError, ValueError) as e:
+            await _sse_send(resp, {"error": {"message": str(e)}})
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        await _sse_send(resp, chunk({}, finish))
+        if include_usage:
+            final = chunk({}, None)
+            final["choices"] = []
+            final["usage"] = usage
+            await _sse_send(resp, final)
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    # ----------------------------------------------------------- completions
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        prompt = body.get("prompt")
+        if isinstance(prompt, list):
+            if len(prompt) != 1 or not isinstance(prompt[0], str):
+                return _error(400, "only a single string prompt is supported")
+            prompt = prompt[0]
+        if not isinstance(prompt, str):
+            return _error(400, "'prompt' must be a string")
+        model = body.get("model") or self.engine.model_id
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+        sampling = _sampling_from(body, default_max=16)
+        stops = _stops_from(body)
+        completion_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            usage = _usage(len(prompt_ids), 0)
+            finish = "stop"
+            try:
+                async for delta in self.engine.stream(prompt_ids, sampling, stops):
+                    if delta.finish_reason is not None:
+                        finish = delta.finish_reason
+                        usage = _usage(delta.prompt_tokens, delta.completion_tokens)
+                    if delta.text:
+                        await _sse_send(
+                            resp,
+                            {
+                                "id": completion_id,
+                                "object": "text_completion",
+                                "created": created,
+                                "model": model,
+                                "choices": [
+                                    {"index": 0, "text": delta.text,
+                                     "finish_reason": None}
+                                ],
+                            },
+                        )
+            except (EngineError, ValueError) as e:
+                await _sse_send(resp, {"error": {"message": str(e)}})
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
+            await _sse_send(
+                resp,
+                {
+                    "id": completion_id,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": model,
+                    "choices": [{"index": 0, "text": "", "finish_reason": finish}],
+                    "usage": usage,
+                },
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        result = await self.engine.complete(prompt_ids, sampling, stops)
+        return web.json_response(
+            {
+                "id": completion_id,
+                "object": "text_completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "text": result.text,
+                        "finish_reason": result.finish_reason,
+                    }
+                ],
+                "usage": _usage(result.prompt_tokens, result.completion_tokens),
+            }
+        )
+
+    # ------------------------------------------------------------- responses
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API — the reference's recommended text path."""
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model") or self.engine.model_id
+        input_ = body.get("input")
+        if isinstance(input_, str):
+            messages = [{"role": "user", "content": input_}]
+        elif isinstance(input_, list):
+            messages = [
+                {"role": m.get("role", "user"), "content": m.get("content", "")}
+                for m in input_
+                if isinstance(m, dict)
+            ]
+        else:
+            return _error(400, "'input' must be a string or message array")
+        if body.get("instructions"):
+            messages = [{"role": "system", "content": body["instructions"]}] + messages
+
+        prompt_ids = self.engine.encode_chat(messages)
+        sampling = _sampling_from(body)
+        response_id = f"resp_{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        def envelope(status: str, text: str, usage: dict | None) -> dict:
+            return {
+                "id": response_id,
+                "object": "response",
+                "created_at": created,
+                "status": status,
+                "model": model,
+                "output": [
+                    {
+                        "type": "message",
+                        "id": f"msg_{response_id}",
+                        "role": "assistant",
+                        "status": status,
+                        "content": [
+                            {"type": "output_text", "text": text, "annotations": []}
+                        ],
+                    }
+                ],
+                "usage": usage
+                or {"input_tokens": 0, "output_tokens": 0, "total_tokens": 0},
+            }
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                status=200, headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+
+            async def event(name: str, payload: dict) -> None:
+                data = json.dumps(payload, separators=(",", ":"))
+                await resp.write(f"event: {name}\ndata: {data}\n\n".encode())
+
+            await event(
+                "response.created",
+                {"type": "response.created",
+                 "response": envelope("in_progress", "", None)},
+            )
+            text_parts: list[str] = []
+            usage = None
+            try:
+                async for delta in self.engine.stream(
+                    prompt_ids, sampling, _stops_from(body)
+                ):
+                    if delta.text:
+                        text_parts.append(delta.text)
+                        await event(
+                            "response.output_text.delta",
+                            {
+                                "type": "response.output_text.delta",
+                                "item_id": f"msg_{response_id}",
+                                "output_index": 0,
+                                "content_index": 0,
+                                "delta": delta.text,
+                            },
+                        )
+                    if delta.finish_reason is not None:
+                        usage = {
+                            "input_tokens": delta.prompt_tokens,
+                            "output_tokens": delta.completion_tokens,
+                            "total_tokens": (
+                                delta.prompt_tokens + delta.completion_tokens
+                            ),
+                        }
+            except (EngineError, ValueError) as e:
+                await event(
+                    "response.failed",
+                    {
+                        "type": "response.failed",
+                        "response": {
+                            "id": response_id,
+                            "object": "response",
+                            "status": "failed",
+                            "error": {"message": str(e)},
+                        },
+                    },
+                )
+                return resp
+            await event(
+                "response.completed",
+                {
+                    "type": "response.completed",
+                    "response": envelope("completed", "".join(text_parts), usage),
+                },
+            )
+            return resp
+
+        result = await self.engine.complete(prompt_ids, sampling, _stops_from(body))
+        usage = {
+            "input_tokens": result.prompt_tokens,
+            "output_tokens": result.completion_tokens,
+            "total_tokens": result.prompt_tokens + result.completion_tokens,
+        }
+        return web.json_response(envelope("completed", result.text, usage))
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    """Normalize engine/validation failures to OpenAI-style JSON errors."""
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except ValueError as e:
+        return _error(400, str(e))
+    except EngineError as e:
+        return _error(500, str(e), "server_error")
+    except Exception:
+        log.exception("unhandled error serving %s", request.path)
+        return _error(500, "internal server error", "server_error")
+
+
+def create_engine_app(engine: Engine, *, owns_engine: bool = True) -> web.Application:
+    app = web.Application(client_max_size=MAX_BODY_BYTES, middlewares=[error_middleware])
+    api = EngineAPI(engine)
+    app.router.add_get("/v1/models", api.list_models)
+    app.router.add_post("/v1/chat/completions", api.chat_completions)
+    app.router.add_post("/v1/completions", api.completions)
+    app.router.add_post("/v1/responses", api.responses)
+    app.router.add_get("/api/health", api.health)
+    app.router.add_get("/api/system", api.system)
+
+    if owns_engine:
+        async def on_shutdown(app):
+            engine.shutdown()
+
+        app.on_shutdown.append(on_shutdown)
+    return app
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="llmlb_tpu inference engine")
+    parser.add_argument("--preset", default="debug-tiny")
+    parser.add_argument("--checkpoint", default=None)
+    parser.add_argument("--model-id", default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--num-slots", type=int, default=8)
+    parser.add_argument("--slot-capacity", type=int, default=512)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.checkpoint:
+        engine = Engine.from_checkpoint(
+            args.checkpoint, model_id=args.model_id,
+            num_slots=args.num_slots, slot_capacity=args.slot_capacity,
+        )
+    else:
+        engine = Engine.from_preset(
+            args.preset, model_id=args.model_id,
+            num_slots=args.num_slots, slot_capacity=args.slot_capacity,
+        )
+    web.run_app(create_engine_app(engine), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

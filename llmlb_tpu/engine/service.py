@@ -1,0 +1,227 @@
+"""High-level engine service: text in, streamed text out.
+
+Bridges the HTTP layer to the EngineCore step loop: chat templating, token
+encode/decode, stop-sequence handling, usage accounting, and async iteration
+over the core's thread-side event queues.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import AsyncIterator
+
+import jax
+
+from llmlb_tpu.engine.presets import get_preset
+from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+from llmlb_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    IncrementalDetokenizer,
+    Tokenizer,
+)
+
+
+@dataclasses.dataclass
+class StreamDelta:
+    text: str = ""
+    finish_reason: str | None = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    ttft_s: float | None = None
+
+
+class Engine:
+    """One served model: config + weights + tokenizer + scheduler core."""
+
+    def __init__(
+        self,
+        model_id: str,
+        core: EngineCore,
+        tokenizer: Tokenizer,
+    ):
+        self.model_id = model_id
+        self.core = core
+        self.tokenizer = tokenizer
+        # Event bridging blocks a thread per in-flight stream; size accordingly.
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(32, core.num_slots * 4),
+            thread_name_prefix="engine-events",
+        )
+
+    # ------------------------------------------------------------ construction
+
+    @classmethod
+    def from_preset(
+        cls,
+        preset: str,
+        *,
+        model_id: str | None = None,
+        checkpoint_dir: str | None = None,
+        **core_kwargs,
+    ) -> "Engine":
+        """Build from a named preset; random weights unless checkpoint_dir."""
+        cfg = get_preset(preset)
+        params = None
+        tokenizer: Tokenizer
+        if checkpoint_dir:
+            from llmlb_tpu.engine.weights import load_checkpoint, load_config
+
+            cfg = load_config(checkpoint_dir, dtype=cfg.dtype)
+            tokenizer = HFTokenizer(checkpoint_dir)
+            params = load_checkpoint(checkpoint_dir, cfg)
+        else:
+            tokenizer = ByteTokenizer(cfg.vocab_size)
+        core = EngineCore(
+            cfg, params, eos_id=tokenizer.eos_id, **core_kwargs
+        )
+        core.start()
+        return cls(model_id or preset, core, tokenizer)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str, *, model_id: str | None = None,
+                        **core_kwargs) -> "Engine":
+        from llmlb_tpu.engine.weights import load_checkpoint, load_config
+
+        cfg = load_config(checkpoint_dir)
+        tokenizer = HFTokenizer(checkpoint_dir)
+        params = load_checkpoint(checkpoint_dir, cfg)
+        core = EngineCore(cfg, params, eos_id=tokenizer.eos_id, **core_kwargs)
+        core.start()
+        return cls(
+            model_id or os.path.basename(checkpoint_dir.rstrip("/")),
+            core,
+            tokenizer,
+        )
+
+    def shutdown(self) -> None:
+        self.core.stop()
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+    # --------------------------------------------------------------- serving
+
+    def encode_chat(self, messages: list[dict]) -> list[int]:
+        return self.tokenizer.encode(self.tokenizer.apply_chat_template(messages))
+
+    async def stream(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        stop: list[str] | None = None,
+    ) -> AsyncIterator[StreamDelta]:
+        """Submit and stream deltas. Final delta carries finish_reason + usage.
+
+        Stop sequences may straddle token/delta boundaries, so the last
+        `max(len(stop)) - 1` characters are held back until the stream resolves;
+        a stop hit truncates before anything past it is emitted. Early exit
+        (stop hit, client gone) cancels the request so its slot frees promptly.
+        """
+        request = Request(prompt_ids=prompt_ids, sampling=sampling)
+        loop = asyncio.get_running_loop()
+        self.core.submit(request)
+
+        detok = IncrementalDetokenizer(self.tokenizer)
+        stop = [s for s in (stop or []) if s]
+        holdback = max((len(s) for s in stop), default=1) - 1
+        acc = ""  # decoded text; [:emitted] has been yielded
+        emitted = 0
+        completion_tokens = 0
+        ttft: float | None = None  # attached to the first yielded delta
+        finished = False
+
+        def final(text: str, reason: str) -> StreamDelta:
+            return StreamDelta(
+                text=text,
+                finish_reason=reason,
+                prompt_tokens=len(prompt_ids),
+                completion_tokens=completion_tokens,
+                ttft_s=ttft,
+            )
+
+        try:
+            while True:
+                kind, value = await loop.run_in_executor(
+                    self._executor, request.events.get
+                )
+                if kind == "error":
+                    raise EngineError(str(value))
+                if kind == "token":
+                    completion_tokens += 1
+                    if completion_tokens == 1 and request.first_token_at:
+                        ttft = request.first_token_at - request.submitted_at
+                    acc += detok.push(int(value))
+                else:  # done
+                    acc += detok.flush()
+
+                hit = _find_stop(acc, stop)
+                if hit is not None:
+                    finished = True
+                    request.cancel()
+                    yield final(acc[emitted:hit], "stop")
+                    return
+                if kind == "done":
+                    finished = True
+                    yield final(acc[emitted:], str(value))
+                    return
+                boundary = max(emitted, len(acc) - holdback)
+                if boundary > emitted:
+                    delta = StreamDelta(text=acc[emitted:boundary], ttft_s=ttft)
+                    ttft = None  # report once
+                    emitted = boundary
+                    yield delta
+        finally:
+            if not finished:
+                request.cancel()
+
+    async def complete(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        stop: list[str] | None = None,
+    ) -> StreamDelta:
+        """Non-streaming: collect the full completion."""
+        text = []
+        final: StreamDelta | None = None
+        async for delta in self.stream(prompt_ids, sampling, stop):
+            text.append(delta.text)
+            if delta.finish_reason is not None:
+                final = delta
+        assert final is not None
+        return dataclasses.replace(final, text="".join(text))
+
+    def health(self) -> dict:
+        from llmlb_tpu.engine.telemetry import device_telemetry
+
+        stats = self.core.stats()
+        return {
+            "status": "ok",
+            "model": self.model_id,
+            "engine": {
+                "num_slots": stats.num_slots,
+                "active_slots": stats.active_slots,
+                "queued": stats.queued,
+                "total_requests": stats.total_requests,
+                "total_tokens": stats.total_tokens,
+                "uptime_s": round(stats.uptime_s, 3),
+                "mesh": dict(self.core.mesh.shape),
+            },
+            "tpu": device_telemetry(),
+        }
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+def _find_stop(text: str, stops: list[str]) -> int | None:
+    best: int | None = None
+    for s in stops:
+        if not s:
+            continue
+        idx = text.find(s)
+        if idx != -1 and (best is None or idx < best):
+            best = idx
+    return best

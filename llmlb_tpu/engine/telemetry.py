@@ -1,0 +1,46 @@
+"""TPU chip/HBM telemetry for the engine's /api/health endpoint.
+
+Replaces the GPU VRAM/utilization fields the reference's health checker reads
+from xLLM endpoints (/root/reference/llmlb/src/health/endpoint_checker.rs:515,
+types/health.rs) with libtpu-backed figures surfaced through JAX device APIs.
+The gateway's scheduler consumes these for placement decisions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def device_telemetry() -> dict[str, Any]:
+    devices = jax.local_devices()
+    chips = []
+    hbm_used_total = 0
+    hbm_limit_total = 0
+    for d in devices:
+        stats: dict[str, Any] = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        used = int(stats.get("bytes_in_use", 0))
+        limit = int(stats.get("bytes_limit", 0))
+        hbm_used_total += used
+        hbm_limit_total += limit
+        chips.append(
+            {
+                "id": d.id,
+                "platform": d.platform,
+                "device_kind": getattr(d, "device_kind", "unknown"),
+                "hbm_used_bytes": used,
+                "hbm_total_bytes": limit,
+            }
+        )
+    return {
+        "accelerator": devices[0].platform if devices else "none",
+        "chip_count": len(devices),
+        "hbm_used_bytes": hbm_used_total,
+        "hbm_total_bytes": hbm_limit_total,
+        "chips": chips,
+    }

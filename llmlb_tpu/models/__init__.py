@@ -5,6 +5,7 @@ from llmlb_tpu.models.llama import (
     kv_cache_shardings,
     init_kv_cache,
     prefill,
+    prefill_into_slots,
     decode_step,
 )
 
@@ -15,5 +16,6 @@ __all__ = [
     "kv_cache_shardings",
     "init_kv_cache",
     "prefill",
+    "prefill_into_slots",
     "decode_step",
 ]

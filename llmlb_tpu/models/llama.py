@@ -240,33 +240,23 @@ def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
-def prefill(
-    params: Params,
-    cfg: LlamaConfig,
-    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
-    prompt_lens: jnp.ndarray,  # [B] int32
-    cache_k: jnp.ndarray,  # [L, B, S, K, D] — fresh slots, written at [0:T]
-    cache_v: jnp.ndarray,
-):
-    """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
-    cache_k, cache_v)."""
+def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv):
+    """Shared prefill body; `write_kv(cache, new_kv, positions)` places K/V."""
     b, t = input_ids.shape
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
 
     x = params["embed"][input_ids]  # [B, T, E]
-
     stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
 
     def layer(carry_x, layer_in):
-        lp, ck, cv = layer_in  # ck/cv: [B, S, K, D]
+        lp, ck, cv = layer_in
         h = rms_norm(carry_x, lp["ln_attn"], cfg.rms_eps)
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        ck = write_kv(ck, k.astype(ck.dtype), positions)
+        cv = write_kv(cv, v.astype(cv.dtype), positions)
         attn = gqa_attention_prefill(q, k, v, prompt_lens)
         carry_x = carry_x + attn.reshape(b, t, -1) @ lp["wo"]
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
@@ -279,6 +269,49 @@ def prefill(
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
     logits = _unembed(cfg, params, x_last)
     return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+def prefill(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [B] int32
+    cache_k: jnp.ndarray,  # [L, B, S, K, D] — fresh slots, written at [0:T]
+    cache_v: jnp.ndarray,
+):
+    """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
+    cache_k, cache_v)."""
+
+    def write_kv(cache, kv, positions):
+        return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
+
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+def prefill_into_slots(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [B] int32
+    slot_ids: jnp.ndarray,  # [B] int32 — target rows in the global slot cache
+    cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D] — the engine's live cache
+    cache_v: jnp.ndarray,
+):
+    """Prefill B prompts and scatter their KV into rows `slot_ids` of the live
+    slot cache — the continuous-batching insert path (new requests land in freed
+    slots while other slots keep decoding). Returns (last_logits [B, V] fp32,
+    cache_k, cache_v)."""
+
+    def write_kv(cache, kv, positions):
+        return cache.at[slot_ids[:, None], positions].set(kv)
+
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))

@@ -68,6 +68,19 @@ def largest_pow2_tp(n_devices: int, num_kv_heads: int) -> int:
     return tp
 
 
+def default_tp(n_devices: int, num_heads: int, num_kv_heads: int) -> int:
+    """Largest valid power-of-two tp degree for a model (kv replication allowed)."""
+    tp = 1
+    while True:
+        cand = tp * 2
+        if cand > n_devices or n_devices % cand or num_heads % cand:
+            break
+        if num_kv_heads % cand and cand % num_kv_heads:
+            break
+        tp = cand
+    return tp
+
+
 def is_power_of_two(n: int) -> bool:
     return n > 0 and (n & (n - 1)) == 0
 

@@ -7,17 +7,17 @@ ephemeral localhost ports, SQLite in-memory/tmpdir.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
+# Env vars alone are not enough here: the machine image injects an `axon` TPU
+# plugin via PYTHONPATH sitecustomize that overrides JAX_PLATFORMS. jax.config
+# updates before first backend use win over it.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 # fp32 tests compare against float64/torch references; JAX's default ("fastest")
 # matmul precision is bf16-grade even on CPU.
-os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -80,6 +80,8 @@ def test_matches_hf_transformers(attention_bias, tie):
     torch = pytest.importorskip("torch")
     import transformers
 
+    torch.manual_seed(0)
+
     from llmlb_tpu.engine.weights import convert_hf_tensors
 
     if attention_bias:
