@@ -41,7 +41,10 @@ def main() -> None:
     )
     from llmlb_tpu.ops.sampling import sample_tokens
 
-    n_chips = len(jax.devices())
+    # Unsharded single-device run: params and caches live on the default
+    # device, so throughput is per-chip by construction regardless of how many
+    # chips the host exposes.
+    n_chips = 1
     cfg = get_preset("tinyllama-1.1b")
 
     params = init_params(cfg, jax.random.PRNGKey(0))

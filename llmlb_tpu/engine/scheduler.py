@@ -130,13 +130,16 @@ class EngineCore:
         self.cache_k = jax.device_put(ck, ck_sh)
         self.cache_v = jax.device_put(cv, cv_sh)
 
-        # Host-side slot bookkeeping; device-side mirrors rebuilt on change.
+        # Host-side slot bookkeeping (lengths mirror device state for stop
+        # checks without D2H); sampling params + tokens live ON DEVICE and are
+        # only touched at insert time — the decode hot loop does zero H2D.
         self.slots = [_Slot() for _ in range(num_slots)]
         self._seq_lens = np.zeros((num_slots,), np.int32)
-        self._temps = np.ones((num_slots,), np.float32)
-        self._top_ps = np.ones((num_slots,), np.float32)
-        self._top_ks = np.zeros((num_slots,), np.int32)
-        self._last_tokens = np.zeros((num_slots,), np.int32)
+        self._d_seq_lens = jnp.zeros((num_slots,), jnp.int32)
+        self._d_temps = jnp.ones((num_slots,), jnp.float32)
+        self._d_top_ps = jnp.ones((num_slots,), jnp.float32)
+        self._d_top_ks = jnp.zeros((num_slots,), jnp.int32)
+        self._d_last_tokens = jnp.zeros((num_slots,), jnp.int32)
         self._key = jax.random.PRNGKey(seed)
 
         self.pending: queue.SimpleQueue[Request] = queue.SimpleQueue()
@@ -160,13 +163,23 @@ class EngineCore:
         self._running = False
         if self._thread:
             self._thread.join(timeout=30)
+        # terminal events for everything still in flight so waiters unblock
+        self._fail_all("engine shutting down")
 
     def submit(self, request: Request) -> Request:
+        n = len(request.prompt_ids)
+        if n == 0:
+            raise ValueError("prompt must contain at least one token")
         max_prompt = self.prefill_buckets[-1] if self.prefill_buckets else 0
-        if len(request.prompt_ids) > max_prompt:
+        if n > max_prompt:
             raise ValueError(
-                f"prompt of {len(request.prompt_ids)} tokens exceeds the "
-                f"largest prefill bucket ({max_prompt})"
+                f"prompt of {n} tokens exceeds the largest prefill bucket "
+                f"({max_prompt})"
+            )
+        if n + 1 >= self.slot_capacity:
+            raise ValueError(
+                f"prompt of {n} tokens does not fit the slot capacity "
+                f"({self.slot_capacity}) with room to generate"
             )
         with self._lock:
             self.total_requests += 1
@@ -219,7 +232,8 @@ class EngineCore:
         self.cache_k = jax.device_put(ck, ck_sh)
         self.cache_v = jax.device_put(cv, cv_sh)
         self._seq_lens[:] = 0
-        self._last_tokens[:] = 0
+        self._d_seq_lens = jnp.zeros((self.num_slots,), jnp.int32)
+        self._d_last_tokens = jnp.zeros((self.num_slots,), jnp.int32)
 
     def _try_insert(self) -> bool:
         slot_id = self._free_slot()
@@ -257,25 +271,25 @@ class EngineCore:
         slot.request = request
         slot.generated = 0
         self._seq_lens[slot_id] = n
-        self._temps[slot_id] = request.sampling.temperature
-        self._top_ps[slot_id] = request.sampling.top_p
-        self._top_ks[slot_id] = request.sampling.top_k
 
-        # Sample the first token straight from the prefill logits.
+        # Sample the first token straight from the prefill logits, then land
+        # the slot's device-side state in one scatter (insert-time only; the
+        # decode loop never uploads host state).
         self._key, sk = jax.random.split(self._key)
-        token = int(
-            np.asarray(
-                sample_tokens(
-                    logits,
-                    sk,
-                    jnp.asarray(self._temps[slot_id : slot_id + 1]),
-                    jnp.asarray(self._top_ps[slot_id : slot_id + 1]),
-                    jnp.asarray(self._top_ks[slot_id : slot_id + 1]),
-                )
-            )[0]
-        )
+        s = request.sampling
+        temp = jnp.float32(s.temperature)
+        first = sample_tokens(
+            logits, sk, temp[None], jnp.float32(s.top_p)[None],
+            jnp.int32(s.top_k)[None],
+        )[0]
+        self._d_temps = self._d_temps.at[slot_id].set(temp)
+        self._d_top_ps = self._d_top_ps.at[slot_id].set(s.top_p)
+        self._d_top_ks = self._d_top_ks.at[slot_id].set(s.top_k)
+        self._d_seq_lens = self._d_seq_lens.at[slot_id].set(n)
+        self._d_last_tokens = self._d_last_tokens.at[slot_id].set(first)
+
         request.first_token_at = time.monotonic()
-        self._emit(slot_id, token)
+        self._emit(slot_id, int(first))
         return True
 
     def _decode_active(self) -> bool:
@@ -287,20 +301,17 @@ class EngineCore:
         logits, self.cache_k, self.cache_v = decode_step(
             self.params,
             self.cfg,
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._seq_lens),
+            self._d_last_tokens,
+            self._d_seq_lens,
             self.cache_k,
             self.cache_v,
         )
-        tokens = np.asarray(
-            sample_tokens(
-                logits,
-                sk,
-                jnp.asarray(self._temps),
-                jnp.asarray(self._top_ps),
-                jnp.asarray(self._top_ks),
-            )
+        tokens_dev = sample_tokens(
+            logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks
         )
+        self._d_last_tokens = tokens_dev
+        self._d_seq_lens = self._d_seq_lens + 1
+        tokens = np.asarray(tokens_dev)  # the one D2H sync per step
         self._seq_lens[active] += 1
         for i in active:
             self._emit(i, int(tokens[i]))
@@ -316,7 +327,6 @@ class EngineCore:
             slot.request = None
             slot.generated = 0
             return
-        self._last_tokens[slot_id] = token
         slot.generated += 1
         with self._lock:
             self.total_tokens += 1

@@ -235,8 +235,8 @@ class EngineAPI:
             if len(prompt) != 1 or not isinstance(prompt[0], str):
                 return _error(400, "only a single string prompt is supported")
             prompt = prompt[0]
-        if not isinstance(prompt, str):
-            return _error(400, "'prompt' must be a string")
+        if not isinstance(prompt, str) or not prompt:
+            return _error(400, "'prompt' must be a non-empty string")
         model = body.get("model") or self.engine.model_id
         prompt_ids = self.engine.tokenizer.encode(prompt)
         sampling = _sampling_from(body, default_max=16)

@@ -1,0 +1,9 @@
+"""The gateway: an OpenAI-compatible LLM load balancer fronting many endpoints.
+
+Python/aiohttp re-design of the reference's Rust axum server (SURVEY.md §1-§3):
+API surface (OpenAI /v1/*, Anthropic /v1/messages, admin /api/*, dashboard WS),
+TPS-EMA load balancing with request leases, pull health checking, endpoint type
+detection (tpu:// first), model sync, JWT/API-key auth, tamper-evident audit
+log, SQLite persistence, event bus, drain-aware update gate. Hot-path pieces
+(token accounting, hash chain, TPS tracking) have C++ twins in native/.
+"""

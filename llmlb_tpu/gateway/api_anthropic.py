@@ -1,0 +1,480 @@
+"""Anthropic Messages API adapter: /v1/messages backed by OpenAI endpoints.
+
+Parity with reference api/anthropic.rs: `anthropic:`-prefixed models pass
+through to the cloud natively (:137); local models are served by converting the
+Anthropic request to OpenAI chat (:1048, tools/tool_choice :1218-1321),
+proxying through the normal TPS selection path, then converting back — either
+as a full message response (:1435, stop_reason mapping :1526) or as a stateful
+SSE re-encoding of OpenAI chunks into the Anthropic event stream
+(message_start/content_block_*/message_delta/message_stop incl. tool_use,
+:728-1046).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+
+import aiohttp
+from aiohttp import web
+
+from llmlb_tpu.gateway.api_openai import (
+    QueueTimeout,
+    _record,
+    error_response,
+    select_endpoint_with_queue,
+)
+from llmlb_tpu.gateway.model_names import to_canonical
+from llmlb_tpu.gateway.token_accounting import estimate_tokens
+from llmlb_tpu.gateway.types import Capability, TpsApiKind
+
+ANTHROPIC_BASE = os.environ.get(
+    "LLMLB_ANTHROPIC_BASE_URL", "https://api.anthropic.com"
+)
+
+STOP_REASON_MAP = {
+    "stop": "end_turn",
+    "length": "max_tokens",
+    "tool_calls": "tool_use",
+    "content_filter": "end_turn",
+}
+
+
+def _anthropic_error(status: int, message: str,
+                     err_type: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"type": "error", "error": {"type": err_type, "message": message}},
+        status=status,
+    )
+
+
+# ------------------------------------------------- request/response convert
+
+
+def anthropic_request_to_openai(body: dict) -> dict:
+    """Anthropic /v1/messages body → OpenAI chat body (anthropic.rs:1048)."""
+    messages: list[dict] = []
+    system = body.get("system")
+    if system:
+        if isinstance(system, list):  # content-block system prompts
+            system = "".join(
+                b.get("text", "") for b in system if isinstance(b, dict)
+            )
+        messages.append({"role": "system", "content": system})
+
+    for m in body.get("messages") or []:
+        role = m.get("role")
+        content = m.get("content")
+        if isinstance(content, str):
+            messages.append({"role": role, "content": content})
+            continue
+        # content-block array: text, tool_use (assistant), tool_result (user)
+        text_parts: list[str] = []
+        tool_calls: list[dict] = []
+        for block in content or []:
+            if not isinstance(block, dict):
+                continue
+            btype = block.get("type")
+            if btype == "text":
+                text_parts.append(block.get("text", ""))
+            elif btype == "tool_use":
+                tool_calls.append({
+                    "id": block.get("id") or f"call_{uuid.uuid4().hex[:12]}",
+                    "type": "function",
+                    "function": {
+                        "name": block.get("name", ""),
+                        "arguments": json.dumps(block.get("input") or {}),
+                    },
+                })
+            elif btype == "tool_result":
+                tool_content = block.get("content")
+                if isinstance(tool_content, list):
+                    tool_content = "".join(
+                        b.get("text", "") for b in tool_content
+                        if isinstance(b, dict)
+                    )
+                messages.append({
+                    "role": "tool",
+                    "tool_call_id": block.get("tool_use_id", ""),
+                    "content": tool_content or "",
+                })
+        if text_parts or tool_calls:
+            msg: dict = {"role": role, "content": "".join(text_parts) or None}
+            if tool_calls:
+                msg["tool_calls"] = tool_calls
+            messages.append(msg)
+
+    out: dict = {
+        "model": body.get("model"),
+        "messages": messages,
+        "max_tokens": body.get("max_tokens", 1024),
+    }
+    for src, dst in (("temperature", "temperature"), ("top_p", "top_p"),
+                     ("stream", "stream")):
+        if body.get(src) is not None:
+            out[dst] = body[src]
+    if body.get("stop_sequences"):
+        out["stop"] = body["stop_sequences"]
+    if body.get("tools"):
+        out["tools"] = [
+            {
+                "type": "function",
+                "function": {
+                    "name": t.get("name"),
+                    "description": t.get("description", ""),
+                    "parameters": t.get("input_schema") or {},
+                },
+            }
+            for t in body["tools"]
+            if isinstance(t, dict)
+        ]
+    choice = body.get("tool_choice")
+    if isinstance(choice, dict):
+        ctype = choice.get("type")
+        if ctype == "auto":
+            out["tool_choice"] = "auto"
+        elif ctype == "any":
+            out["tool_choice"] = "required"
+        elif ctype == "tool":
+            out["tool_choice"] = {
+                "type": "function",
+                "function": {"name": choice.get("name", "")},
+            }
+    return out
+
+
+def openai_response_to_anthropic(resp: dict, model: str) -> dict:
+    """OpenAI chat response → Anthropic message response (anthropic.rs:1435)."""
+    content: list[dict] = []
+    finish = "stop"
+    choices = resp.get("choices") or []
+    if choices:
+        choice = choices[0]
+        finish = choice.get("finish_reason") or "stop"
+        msg = choice.get("message") or {}
+        if isinstance(msg.get("content"), str) and msg["content"]:
+            content.append({"type": "text", "text": msg["content"]})
+        for tc in msg.get("tool_calls") or []:
+            fn = tc.get("function") or {}
+            try:
+                args = json.loads(fn.get("arguments") or "{}")
+            except ValueError:
+                args = {}
+            content.append({
+                "type": "tool_use",
+                "id": tc.get("id") or f"toolu_{uuid.uuid4().hex[:12]}",
+                "name": fn.get("name", ""),
+                "input": args,
+            })
+    usage = resp.get("usage") or {}
+    return {
+        "id": f"msg_{uuid.uuid4().hex[:24]}",
+        "type": "message",
+        "role": "assistant",
+        "model": model,
+        "content": content,
+        "stop_reason": STOP_REASON_MAP.get(finish, "end_turn"),
+        "stop_sequence": None,
+        "usage": {
+            "input_tokens": usage.get("prompt_tokens", 0),
+            "output_tokens": usage.get("completion_tokens", 0),
+        },
+    }
+
+
+class AnthropicStreamEncoder:
+    """Re-encodes OpenAI chat chunks as Anthropic SSE events (anthropic.rs:728).
+
+    Stateful: tracks the open content block (text vs tool_use) and emits
+    block start/stop transitions, then message_delta with stop_reason/usage
+    and message_stop at the end.
+    """
+
+    def __init__(self, model: str):
+        self.model = model
+        self.message_id = f"msg_{uuid.uuid4().hex[:24]}"
+        self.started = False
+        self.block_index = -1
+        self.block_type: str | None = None  # "text" | "tool_use"
+        self.finish_reason: str | None = None
+        self.usage = {"input_tokens": 0, "output_tokens": 0}
+        self._tool_ids: dict[int, str] = {}
+
+    @staticmethod
+    def _event(name: str, payload: dict) -> bytes:
+        return (
+            f"event: {name}\ndata: "
+            f"{json.dumps(payload, separators=(',', ':'))}\n\n"
+        ).encode()
+
+    def start(self) -> bytes:
+        self.started = True
+        return self._event("message_start", {
+            "type": "message_start",
+            "message": {
+                "id": self.message_id, "type": "message", "role": "assistant",
+                "model": self.model, "content": [],
+                "stop_reason": None, "stop_sequence": None,
+                "usage": {"input_tokens": 0, "output_tokens": 0},
+            },
+        })
+
+    def _close_block(self) -> list[bytes]:
+        if self.block_type is None:
+            return []
+        out = [self._event("content_block_stop", {
+            "type": "content_block_stop", "index": self.block_index,
+        })]
+        self.block_type = None
+        return out
+
+    def _open_block(self, btype: str, header: dict) -> list[bytes]:
+        out = self._close_block()
+        self.block_index += 1
+        self.block_type = btype
+        out.append(self._event("content_block_start", {
+            "type": "content_block_start", "index": self.block_index,
+            "content_block": header,
+        }))
+        return out
+
+    def feed(self, chunk: dict) -> list[bytes]:
+        """Consume one OpenAI chunk dict; returns encoded Anthropic events."""
+        out: list[bytes] = []
+        if not self.started:
+            out.append(self.start())
+        usage = chunk.get("usage")
+        if isinstance(usage, dict):
+            self.usage = {
+                "input_tokens": usage.get("prompt_tokens", 0),
+                "output_tokens": usage.get("completion_tokens", 0),
+            }
+        for choice in chunk.get("choices") or []:
+            if not isinstance(choice, dict):
+                continue
+            if choice.get("finish_reason"):
+                self.finish_reason = choice["finish_reason"]
+            delta = choice.get("delta") or {}
+            content = delta.get("content")
+            if isinstance(content, str) and content:
+                if self.block_type != "text":
+                    out.extend(self._open_block(
+                        "text", {"type": "text", "text": ""}
+                    ))
+                out.append(self._event("content_block_delta", {
+                    "type": "content_block_delta", "index": self.block_index,
+                    "delta": {"type": "text_delta", "text": content},
+                }))
+            for tc in delta.get("tool_calls") or []:
+                idx = tc.get("index", 0)
+                fn = tc.get("function") or {}
+                if tc.get("id") or fn.get("name"):
+                    tool_id = tc.get("id") or f"toolu_{uuid.uuid4().hex[:12]}"
+                    self._tool_ids[idx] = tool_id
+                    out.extend(self._open_block("tool_use", {
+                        "type": "tool_use", "id": tool_id,
+                        "name": fn.get("name", ""), "input": {},
+                    }))
+                if fn.get("arguments"):
+                    out.append(self._event("content_block_delta", {
+                        "type": "content_block_delta", "index": self.block_index,
+                        "delta": {"type": "input_json_delta",
+                                  "partial_json": fn["arguments"]},
+                    }))
+        return out
+
+    def finish(self) -> list[bytes]:
+        out = self._close_block()
+        out.append(self._event("message_delta", {
+            "type": "message_delta",
+            "delta": {
+                "stop_reason": STOP_REASON_MAP.get(
+                    self.finish_reason or "stop", "end_turn"
+                ),
+                "stop_sequence": None,
+            },
+            "usage": {"output_tokens": self.usage["output_tokens"]},
+        }))
+        out.append(self._event("message_stop", {"type": "message_stop"}))
+        return out
+
+
+# ------------------------------------------------------------------ handler
+
+
+async def messages(request: web.Request) -> web.StreamResponse:
+    state = request.app["state"]
+    started = time.monotonic()
+    try:
+        body = await request.json()
+    except Exception:
+        return _anthropic_error(400, "invalid JSON body")
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        return _anthropic_error(400, "'model' is required")
+    if not body.get("messages"):
+        return _anthropic_error(400, "'messages' is required")
+    if body.get("max_tokens") is None:
+        return _anthropic_error(400, "'max_tokens' is required")
+
+    if model.startswith("anthropic:"):
+        return await _cloud_passthrough(request, state, body,
+                                        model[len("anthropic:"):])
+
+    canonical = to_canonical(model)
+    openai_body = anthropic_request_to_openai(body)
+    try:
+        selection = await select_endpoint_with_queue(
+            state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT
+        )
+    except QueueTimeout:
+        return _anthropic_error(503, "all endpoints busy", "overloaded_error")
+    if selection is None:
+        return _anthropic_error(
+            404, f"model {model!r} is not available", "not_found_error"
+        )
+    endpoint, engine_model = selection
+    openai_body["model"] = engine_model
+    is_stream = bool(body.get("stream"))
+    if is_stream:
+        openai_body["stream"] = True
+        openai_body["stream_options"] = {"include_usage": True}
+
+    headers = {"Content-Type": "application/json"}
+    if endpoint.api_key:
+        headers["Authorization"] = f"Bearer {endpoint.api_key}"
+    lease = state.load_manager.begin_request(endpoint, canonical, TpsApiKind.CHAT)
+    try:
+        upstream = await state.http.post(
+            endpoint.url + "/v1/chat/completions",
+            json=openai_body,
+            headers=headers,
+            timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
+        )
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        lease.fail()
+        return _anthropic_error(
+            502, f"upstream unreachable: {type(e).__name__}", "api_error"
+        )
+
+    if upstream.status != 200:
+        detail = (await upstream.read())[:1024].decode(errors="replace")
+        upstream.release()
+        lease.fail()
+        _record(state, endpoint=endpoint, model=canonical,
+                api_kind=TpsApiKind.CHAT, path="/v1/messages", status=502,
+                started=started, client_ip=request.remote,
+                auth=request.get("auth"), error=detail)
+        return _anthropic_error(
+            502, f"upstream returned {upstream.status}: {detail}", "api_error"
+        )
+
+    if is_stream:
+        return await _stream_transform(
+            request, state, upstream, endpoint, canonical, started, lease, body
+        )
+
+    raw = await upstream.read()
+    upstream.release()
+    try:
+        openai_resp = json.loads(raw)
+    except ValueError:
+        lease.fail()
+        return _anthropic_error(502, "invalid upstream response", "api_error")
+    anthropic_resp = openai_response_to_anthropic(openai_resp, model)
+    usage = anthropic_resp["usage"]
+    lease.complete_with_tokens(usage["input_tokens"], usage["output_tokens"])
+    _record(state, endpoint=endpoint, model=canonical, api_kind=TpsApiKind.CHAT,
+            path="/v1/messages", status=200, started=started,
+            prompt_tokens=usage["input_tokens"],
+            completion_tokens=usage["output_tokens"],
+            client_ip=request.remote, auth=request.get("auth"))
+    return web.json_response(anthropic_resp)
+
+
+async def _stream_transform(
+    request, state, upstream, endpoint, model, started, lease, original_body
+) -> web.StreamResponse:
+    resp = web.StreamResponse(
+        status=200, headers={"Content-Type": "text/event-stream"}
+    )
+    await resp.prepare(request)
+    lease.complete()
+    encoder = AnthropicStreamEncoder(original_body.get("model", model))
+    buffer = b""
+    try:
+        async for raw_chunk in upstream.content.iter_any():
+            buffer += raw_chunk
+            while b"\n" in buffer:
+                line, buffer = buffer.split(b"\n", 1)
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[len(b"data:"):].strip()
+                if not data or data == b"[DONE]":
+                    continue
+                try:
+                    chunk = json.loads(data)
+                except ValueError:
+                    continue
+                for event in encoder.feed(chunk):
+                    await resp.write(event)
+        for event in encoder.finish():
+            await resp.write(event)
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            ConnectionResetError):
+        pass
+    finally:
+        upstream.release()
+        ct = encoder.usage["output_tokens"]
+        duration_s = time.monotonic() - started
+        if ct:
+            state.load_manager.update_tps(
+                endpoint.id, model, TpsApiKind.CHAT, ct, duration_s
+            )
+        _record(state, endpoint=endpoint, model=model, api_kind=TpsApiKind.CHAT,
+                path="/v1/messages", status=200, started=started,
+                prompt_tokens=encoder.usage["input_tokens"],
+                completion_tokens=ct, client_ip=request.remote,
+                auth=request.get("auth"), stream=True)
+    return resp
+
+
+async def _cloud_passthrough(request, state, body, model) -> web.StreamResponse:
+    key = os.environ.get("ANTHROPIC_API_KEY")
+    if not key:
+        return _anthropic_error(
+            401, "ANTHROPIC_API_KEY not configured", "authentication_error"
+        )
+    payload = dict(body)
+    payload["model"] = model
+    upstream = await state.http.post(
+        ANTHROPIC_BASE + "/v1/messages",
+        json=payload,
+        headers={
+            "x-api-key": key,
+            "anthropic-version": request.headers.get(
+                "anthropic-version", "2023-06-01"
+            ),
+        },
+        timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
+    )
+    if payload.get("stream"):
+        resp = web.StreamResponse(
+            status=upstream.status,
+            headers={"Content-Type": "text/event-stream"},
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in upstream.content.iter_any():
+                await resp.write(chunk)
+        finally:
+            upstream.release()
+        return resp
+    raw = await upstream.read()
+    upstream.release()
+    return web.Response(body=raw, status=upstream.status,
+                        content_type="application/json")

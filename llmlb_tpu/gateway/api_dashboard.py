@@ -1,0 +1,245 @@
+"""Dashboard data APIs + live WebSocket.
+
+Parity with reference api/dashboard.rs (overview/stats/history/token stats/
+client analytics :171-1254) and api/dashboard_ws.rs (JWT-auth WS pushing event
+bus messages :36-76). Data comes from the in-memory 60-min history ring plus
+the daily-stats and request_history tables.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import logging
+
+from aiohttp import WSMsgType, web
+
+from llmlb_tpu.gateway.auth import AuthError, verify_jwt
+
+log = logging.getLogger("llmlb_tpu.gateway.dashboard")
+
+
+async def overview(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    endpoints = state.registry.list_all()
+    online = [e for e in endpoints if e.status.value == "online"]
+    models = state.registry.canonical_model_names()
+    lm_stats = state.load_manager.stats()
+    today = datetime.date.today().isoformat()
+    row = state.db.query_one(
+        """SELECT COALESCE(SUM(request_count),0) AS requests,
+                  COALESCE(SUM(error_count),0) AS errors,
+                  COALESCE(SUM(prompt_tokens),0) AS pt,
+                  COALESCE(SUM(completion_tokens),0) AS ct
+           FROM endpoint_daily_stats WHERE date=?""",
+        (today,),
+    )
+    return web.json_response({
+        "endpoints": {"total": len(endpoints), "online": len(online)},
+        "models": {"total": len(models)},
+        "requests": {
+            "active": lm_stats["active_requests"],
+            "today": row["requests"], "errors_today": row["errors"],
+        },
+        "tokens_today": {"prompt": row["pt"], "completion": row["ct"]},
+        "tpu": {
+            "total_chips": sum(e.accelerator.chip_count for e in online),
+            "hbm_used_bytes": sum(e.accelerator.hbm_used_bytes for e in online),
+            "hbm_total_bytes": sum(e.accelerator.hbm_total_bytes for e in online),
+        },
+    })
+
+
+async def request_history_minutes(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    return web.json_response(
+        {"minutes": state.load_manager.history_minute_buckets()}
+    )
+
+
+async def request_records(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    q = request.query
+    clauses, params = [], []
+    if q.get("model"):
+        clauses.append("model=?")
+        params.append(q["model"])
+    if q.get("endpoint_id"):
+        clauses.append("endpoint_id=?")
+        params.append(q["endpoint_id"])
+    if q.get("status"):
+        clauses.append("status_code=?")
+        params.append(int(q["status"]))
+    where = ("WHERE " + " AND ".join(clauses)) if clauses else ""
+    limit = min(int(q.get("limit", 50)), 500)
+    offset = int(q.get("offset", 0))
+    rows = state.db.query(
+        f"""SELECT id, ts, endpoint_id, endpoint_name, model, api_kind, path,
+                  status_code, duration_ms, prompt_tokens, completion_tokens,
+                  client_ip, stream, error
+           FROM request_history {where} ORDER BY ts DESC LIMIT ? OFFSET ?""",
+        tuple(params) + (limit, offset),
+    )
+    return web.json_response({"records": [dict(r) for r in rows]})
+
+
+async def request_record_detail(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    row = state.db.query_one(
+        "SELECT * FROM request_history WHERE id=?",
+        (request.match_info["record_id"],),
+    )
+    if row is None:
+        return web.json_response({"error": "record not found"}, status=404)
+    return web.json_response(dict(row))
+
+
+async def token_stats(request: web.Request) -> web.Response:
+    """Total/daily/by-model/by-endpoint token statistics."""
+    state = request.app["state"]
+    days = min(int(request.query.get("days", 30)), 365)
+    since = (
+        datetime.date.today() - datetime.timedelta(days=days)
+    ).isoformat()
+    daily = state.db.query(
+        """SELECT date, SUM(prompt_tokens) AS pt, SUM(completion_tokens) AS ct,
+                  SUM(request_count) AS requests
+           FROM endpoint_daily_stats WHERE date>=? GROUP BY date ORDER BY date""",
+        (since,),
+    )
+    by_model = state.db.query(
+        """SELECT model, SUM(prompt_tokens) AS pt,
+                  SUM(completion_tokens) AS ct, SUM(request_count) AS requests
+           FROM endpoint_daily_stats WHERE date>=? GROUP BY model
+           ORDER BY ct DESC""",
+        (since,),
+    )
+    by_endpoint = state.db.query(
+        """SELECT endpoint_id, SUM(prompt_tokens) AS pt,
+                  SUM(completion_tokens) AS ct, SUM(request_count) AS requests
+           FROM endpoint_daily_stats WHERE date>=? GROUP BY endpoint_id""",
+        (since,),
+    )
+    total = state.db.query_one(
+        """SELECT COALESCE(SUM(prompt_tokens),0) AS pt,
+                  COALESCE(SUM(completion_tokens),0) AS ct,
+                  COALESCE(SUM(request_count),0) AS requests
+           FROM endpoint_daily_stats WHERE date>=?""",
+        (since,),
+    )
+    return web.json_response({
+        "total": dict(total),
+        "daily": [dict(r) for r in daily],
+        "by_model": [dict(r) for r in by_model],
+        "by_endpoint": [dict(r) for r in by_endpoint],
+    })
+
+
+async def endpoint_stats(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    endpoint_id = request.match_info["endpoint_id"]
+    days = min(int(request.query.get("days", 30)), 365)
+    since = (
+        datetime.date.today() - datetime.timedelta(days=days)
+    ).isoformat()
+    rows = state.db.query(
+        """SELECT date, model, api_kind, request_count, error_count,
+                  prompt_tokens, completion_tokens, total_duration_ms
+           FROM endpoint_daily_stats
+           WHERE endpoint_id=? AND date>=? ORDER BY date""",
+        (endpoint_id, since),
+    )
+    return web.json_response({"stats": [dict(r) for r in rows]})
+
+
+async def model_tps(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    return web.json_response({"tps": state.load_manager.tps_snapshot()})
+
+
+async def client_analytics(request: web.Request) -> web.Response:
+    """Client-IP rankings / timeline / per-client detail (dashboard.rs analytics)."""
+    state = request.app["state"]
+    q = request.query
+    days = min(int(q.get("days", 7)), 90)
+    since_ts = (
+        datetime.datetime.now() - datetime.timedelta(days=days)
+    ).timestamp()
+    ranking = state.db.query(
+        """SELECT client_ip, COUNT(*) AS requests,
+                  SUM(prompt_tokens) AS pt, SUM(completion_tokens) AS ct,
+                  SUM(CASE WHEN status_code>=400 THEN 1 ELSE 0 END) AS errors
+           FROM request_history WHERE ts>=? AND client_ip IS NOT NULL
+           GROUP BY client_ip ORDER BY requests DESC LIMIT 50""",
+        (since_ts,),
+    )
+    heatmap = state.db.query(
+        """SELECT CAST(strftime('%w', ts, 'unixepoch') AS INTEGER) AS dow,
+                  CAST(strftime('%H', ts, 'unixepoch') AS INTEGER) AS hour,
+                  COUNT(*) AS requests
+           FROM request_history WHERE ts>=?
+           GROUP BY dow, hour""",
+        (since_ts,),
+    )
+    by_key = state.db.query(
+        """SELECT api_key_id, COUNT(*) AS requests,
+                  SUM(completion_tokens) AS ct
+           FROM request_history WHERE ts>=? AND api_key_id IS NOT NULL
+           GROUP BY api_key_id ORDER BY requests DESC LIMIT 50""",
+        (since_ts,),
+    )
+    return web.json_response({
+        "ranking": [dict(r) for r in ranking],
+        "heatmap": [dict(r) for r in heatmap],
+        "by_api_key": [dict(r) for r in by_key],
+    })
+
+
+# ---------------------------------------------------------------- WebSocket
+
+
+async def dashboard_ws(request: web.Request) -> web.WebSocketResponse:
+    """JWT-authenticated (header, query param, or cookie), admin-only."""
+    state = request.app["state"]
+    token = None
+    authz = request.headers.get("Authorization", "")
+    if authz.startswith("Bearer "):
+        token = authz[7:]
+    token = token or request.query.get("token") or request.cookies.get("llmlb_token")
+    if not token:
+        raise web.HTTPUnauthorized(text="missing token")
+    try:
+        payload = verify_jwt(state.jwt_secret, token)
+    except AuthError as e:
+        raise web.HTTPUnauthorized(text=str(e))
+    if payload.get("role") != "admin":
+        raise web.HTTPForbidden(text="admin role required")
+
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+    sub_id, queue = state.events.subscribe()
+    try:
+        consumer = asyncio.create_task(_consume_client(ws))
+        try:
+            while not ws.closed:
+                try:
+                    event = await asyncio.wait_for(queue.get(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    continue
+                await ws.send_str(json.dumps(event, separators=(",", ":")))
+        finally:
+            consumer.cancel()
+    finally:
+        state.events.unsubscribe(sub_id)
+    return ws
+
+
+async def _consume_client(ws: web.WebSocketResponse) -> None:
+    """Drain client frames so pings/closes are processed."""
+    try:
+        async for msg in ws:
+            if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    except Exception:
+        pass

@@ -1,0 +1,183 @@
+"""Audio + image routes: capability-routed proxies.
+
+Parity with reference api/audio.rs (:199-370 transcriptions multipart re-proxy,
+:377 speech) and api/images.rs (:184/:284/:508 generations/edits/variations,
+capability selection :158-182): the gateway validates, selects an endpoint
+advertising the capability, and re-proxies JSON or multipart bodies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+from aiohttp import web
+
+from llmlb_tpu.gateway.api_openai import _record, error_response
+from llmlb_tpu.gateway.types import Capability, TpsApiKind
+
+
+def _select_by_capability(state, capability: Capability, model: str | None):
+    pairs = state.registry.list_online_by_capability(capability)
+    if model:
+        filtered = [
+            (ep, m) for ep, m in pairs
+            if m.canonical_name == model or m.model_id == model
+        ]
+        pairs = filtered or []
+    if not pairs:
+        return None
+    endpoints = [ep for ep, _ in pairs]
+    chosen = state.load_manager.select_endpoint(
+        endpoints, model or capability.value, TpsApiKind.OTHER
+    )
+    if chosen is None:
+        return None
+    engine_model = next(m.model_id for ep, m in pairs if ep.id == chosen.id)
+    return chosen, engine_model
+
+
+async def _reproxy_multipart(
+    request: web.Request, state, endpoint, path: str, model_override: str | None,
+) -> web.Response:
+    """Re-read multipart form and re-emit it toward the endpoint."""
+    reader = await request.multipart()
+    form = aiohttp.FormData()
+    async for part in reader:
+        name = part.name or "file"
+        if part.filename:
+            data = await part.read(decode=False)
+            form.add_field(
+                name, data, filename=part.filename,
+                content_type=part.headers.get("Content-Type"),
+            )
+        else:
+            value = (await part.read(decode=True)).decode(errors="replace")
+            if name == "model" and model_override:
+                value = model_override
+            form.add_field(name, value)
+    headers = {}
+    if endpoint.api_key:
+        headers["Authorization"] = f"Bearer {endpoint.api_key}"
+    upstream = await state.http.post(
+        endpoint.url + path, data=form, headers=headers,
+        timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
+    )
+    raw = await upstream.read()
+    ctype = upstream.headers.get("Content-Type", "application/json")
+    status = upstream.status
+    upstream.release()
+    return web.Response(body=raw, status=status, content_type=ctype.split(";")[0])
+
+
+async def _media_proxy(
+    request: web.Request, capability: Capability, path: str,
+    multipart: bool,
+) -> web.Response:
+    state = request.app["state"]
+    started = time.monotonic()
+    model = None
+    body = None
+    if not multipart:
+        try:
+            body = await request.json()
+        except Exception:
+            return error_response(400, "invalid JSON body")
+        model = body.get("model")
+        if capability == Capability.IMAGE_GENERATION:
+            prompt = body.get("prompt")
+            if not prompt or not isinstance(prompt, str):
+                return error_response(400, "'prompt' is required")
+            n = body.get("n", 1)
+            if not isinstance(n, int) or not 1 <= n <= 10:
+                return error_response(400, "'n' must be between 1 and 10")
+    else:
+        if not (request.content_type or "").startswith("multipart/"):
+            return error_response(400, "multipart/form-data body required")
+
+    selection = _select_by_capability(state, capability, model)
+    if selection is None:
+        return error_response(
+            404,
+            f"no online endpoint provides capability {capability.value!r}"
+            + (f" for model {model!r}" if model else ""),
+        )
+    endpoint, engine_model = selection
+    lease = state.load_manager.begin_request(
+        endpoint, model or capability.value, TpsApiKind.OTHER
+    )
+    try:
+        if multipart:
+            resp = await _reproxy_multipart(
+                request, state, endpoint, path, engine_model
+            )
+        else:
+            payload = dict(body)
+            if model:
+                payload["model"] = engine_model
+            headers = {}
+            if endpoint.api_key:
+                headers["Authorization"] = f"Bearer {endpoint.api_key}"
+            upstream = await state.http.post(
+                endpoint.url + path, json=payload, headers=headers,
+                timeout=aiohttp.ClientTimeout(
+                    total=state.config.inference_timeout_s
+                ),
+            )
+            raw = await upstream.read()
+            ctype = upstream.headers.get("Content-Type", "application/json")
+            status = upstream.status
+            upstream.release()
+            resp = web.Response(
+                body=raw, status=status, content_type=ctype.split(";")[0]
+            )
+        lease.complete()
+        _record(state, endpoint=endpoint, model=model or capability.value,
+                api_kind=TpsApiKind.OTHER, path=path, status=resp.status,
+                started=started, client_ip=request.remote,
+                auth=request.get("auth"))
+        return resp
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        lease.fail()
+        _record(state, endpoint=endpoint, model=model or capability.value,
+                api_kind=TpsApiKind.OTHER, path=path, status=502,
+                started=started, client_ip=request.remote,
+                auth=request.get("auth"), error=str(e))
+        return error_response(
+            502, f"upstream endpoint unreachable: {type(e).__name__}",
+            "server_error",
+        )
+
+
+async def audio_transcriptions(request: web.Request) -> web.Response:
+    return await _media_proxy(
+        request, Capability.AUDIO_TRANSCRIPTION, "/v1/audio/transcriptions",
+        multipart=True,
+    )
+
+
+async def audio_speech(request: web.Request) -> web.Response:
+    return await _media_proxy(
+        request, Capability.AUDIO_SPEECH, "/v1/audio/speech", multipart=False
+    )
+
+
+async def images_generations(request: web.Request) -> web.Response:
+    return await _media_proxy(
+        request, Capability.IMAGE_GENERATION, "/v1/images/generations",
+        multipart=False,
+    )
+
+
+async def images_edits(request: web.Request) -> web.Response:
+    return await _media_proxy(
+        request, Capability.IMAGE_GENERATION, "/v1/images/edits", multipart=True
+    )
+
+
+async def images_variations(request: web.Request) -> web.Response:
+    return await _media_proxy(
+        request, Capability.IMAGE_GENERATION, "/v1/images/variations",
+        multipart=True,
+    )

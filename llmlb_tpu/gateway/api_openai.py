@@ -1,0 +1,402 @@
+"""OpenAI-compatible proxy handlers: the gateway's hot path.
+
+Parity with reference api/openai.rs (chat_completions :155, proxy_openai_post
+:761-1341, list_models :261) and api/proxy.rs (SSE passthrough with TPS
+tracking :120-270): validate model + capability, resolve aliases, TPS-select an
+endpoint, rewrite the payload's `model` to the engine-local name, inject
+stream_options.include_usage, forward with per-endpoint timeout/auth, stream
+bytes through untouched while accounting tokens, normalize upstream failures to
+502, and record history/stats fire-and-forget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+
+import aiohttp
+from aiohttp import web
+
+from llmlb_tpu.gateway.app_state import AppState, record_daily_stat
+from llmlb_tpu.gateway.balancer import RequestRecord
+from llmlb_tpu.gateway.model_names import to_canonical, to_engine_name
+from llmlb_tpu.gateway.token_accounting import (
+    StreamingTokenAccumulator,
+    estimate_tokens,
+    extract_usage_from_response,
+)
+from llmlb_tpu.gateway.types import Capability, Endpoint, TpsApiKind
+
+log = logging.getLogger("llmlb_tpu.gateway.openai")
+
+CLOUD_PREFIXES = ("openai:", "google:", "anthropic:")
+
+
+def error_response(status: int, message: str,
+                   err_type: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": None}},
+        status=status,
+    )
+
+
+def parse_cloud_prefix(model: str) -> tuple[str | None, str]:
+    for prefix in CLOUD_PREFIXES:
+        if model.startswith(prefix):
+            return prefix[:-1], model[len(prefix):]
+    return None, model
+
+
+async def select_endpoint_with_queue(
+    state: AppState, model: str, capability: Capability, api_kind: TpsApiKind
+) -> tuple[Endpoint, str] | None:
+    """TPS-select among online endpoints serving the model; if all are at the
+    admission cap, wait up to queue_timeout for a free slot (queueing parity)."""
+    deadline = time.monotonic() + state.load_manager.queue_config.queue_timeout_s
+    while True:
+        pairs = state.registry.find_by_model(model, capability)
+        if not pairs:
+            return None
+        endpoints = [ep for ep, _ in pairs]
+        chosen = state.load_manager.select_endpoint(endpoints, model, api_kind)
+        if chosen is not None:
+            engine_model = next(
+                m.model_id for ep, m in pairs if ep.id == chosen.id
+            )
+            return chosen, engine_model
+        if time.monotonic() >= deadline:
+            raise QueueTimeout()
+        await asyncio.sleep(0.05)
+
+
+class QueueTimeout(Exception):
+    pass
+
+
+def _record(
+    state: AppState, *, endpoint: Endpoint | None, model: str,
+    api_kind: TpsApiKind, path: str, status: int, started: float,
+    prompt_tokens: int = 0, completion_tokens: int = 0,
+    client_ip: str | None = None, auth: dict | None = None,
+    error: str | None = None, stream: bool = False,
+) -> None:
+    duration_ms = (time.monotonic() - started) * 1000.0
+    eid = endpoint.id if endpoint else None
+    state.load_manager.record_request(RequestRecord(
+        ts=time.time(), endpoint_id=eid or "", model=model, api_kind=api_kind,
+        status_code=status, duration_ms=duration_ms,
+        prompt_tokens=prompt_tokens, completion_tokens=completion_tokens,
+    ))
+    auth = auth or {}
+    state.db.execute(
+        """INSERT INTO request_history
+           (id, ts, endpoint_id, endpoint_name, model, api_kind, path,
+            status_code, duration_ms, prompt_tokens, completion_tokens,
+            client_ip, api_key_id, user_id, stream, error)
+           VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+        (uuid.uuid4().hex, time.time(), eid,
+         endpoint.name if endpoint else None, model, api_kind.value, path,
+         status, duration_ms, prompt_tokens, completion_tokens, client_ip,
+         auth.get("api_key_id"), auth.get("user_id"), int(stream), error),
+    )
+    if endpoint is not None:
+        record_daily_stat(
+            state, endpoint.id, model, api_kind,
+            error=status >= 400, prompt_tokens=prompt_tokens,
+            completion_tokens=completion_tokens, duration_ms=duration_ms,
+        )
+
+
+async def proxy_openai_post(
+    request: web.Request,
+    path: str,
+    api_kind: TpsApiKind,
+    capability: Capability = Capability.CHAT_COMPLETION,
+    prompt_text_fn=None,
+) -> web.StreamResponse:
+    """The generic select→rewrite→forward→account pipeline for /v1/* POSTs."""
+    state: AppState = request.app["state"]
+    started = time.monotonic()
+    try:
+        body = await request.json()
+    except Exception:
+        return error_response(400, "invalid JSON body")
+    if not isinstance(body, dict):
+        return error_response(400, "body must be a JSON object")
+    model = body.get("model")
+    if not model or not isinstance(model, str):
+        return error_response(400, "'model' is required")
+
+    provider, bare_model = parse_cloud_prefix(model)
+    if provider is not None:
+        from llmlb_tpu.gateway.api_cloud import proxy_cloud_request
+
+        return await proxy_cloud_request(
+            request, provider, bare_model, body, path
+        )
+
+    canonical = to_canonical(model)
+    try:
+        selection = await select_endpoint_with_queue(
+            state, canonical, capability, api_kind
+        )
+    except QueueTimeout:
+        return error_response(
+            503, "all endpoints busy; queue timeout exceeded", "server_error"
+        )
+    if selection is None:
+        return error_response(
+            404, f"model {model!r} is not available on any online endpoint",
+            "invalid_request_error",
+        )
+    endpoint, engine_model = selection
+
+    payload = dict(body)
+    # registry knows the engine-local name; fall back to the static alias table
+    payload["model"] = engine_model or to_engine_name(
+        canonical, endpoint.endpoint_type.value
+    )
+    is_stream = bool(payload.get("stream"))
+    if is_stream:
+        # usage in the final chunk feeds the TPS tracker (api/openai.rs:981-992)
+        opts = dict(payload.get("stream_options") or {})
+        opts["include_usage"] = True
+        payload["stream_options"] = opts
+
+    headers = {"Content-Type": "application/json"}
+    if endpoint.api_key:
+        headers["Authorization"] = f"Bearer {endpoint.api_key}"
+
+    lease = state.load_manager.begin_request(endpoint, canonical, api_kind)
+    client_ip = request.remote
+    auth = request.get("auth")
+    prompt_text = prompt_text_fn(body) if prompt_text_fn else ""
+
+    try:
+        upstream = await state.http.post(
+            endpoint.url + path,
+            json=payload,
+            headers=headers,
+            timeout=aiohttp.ClientTimeout(
+                total=state.config.inference_timeout_s, sock_connect=10
+            ),
+        )
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        lease.fail()
+        _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
+                path=path, status=502, started=started, client_ip=client_ip,
+                auth=auth, error=f"{type(e).__name__}: {e}")
+        return error_response(
+            502, f"upstream endpoint unreachable: {type(e).__name__}",
+            "server_error",
+        )
+
+    if upstream.status != 200:
+        # normalize non-2xx upstream to 502 (api/openai.rs:1180)
+        detail = (await upstream.read())[:2048].decode(errors="replace")
+        upstream.release()
+        lease.fail()
+        _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
+                path=path, status=502, started=started, client_ip=client_ip,
+                auth=auth, error=f"upstream HTTP {upstream.status}: {detail}")
+        return error_response(
+            502, f"upstream returned {upstream.status}: {detail}", "server_error"
+        )
+
+    content_type = upstream.headers.get("Content-Type", "")
+    if is_stream and "text/event-stream" in content_type:
+        return await _forward_stream(
+            request, state, upstream, endpoint, canonical, api_kind, path,
+            started, lease, prompt_text, client_ip, auth,
+        )
+
+    raw = await upstream.read()
+    upstream.release()
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        parsed = None
+    usage = extract_usage_from_response(parsed) if isinstance(parsed, dict) else None
+    if usage is None:
+        completion_text = _extract_completion_text(parsed) if parsed else ""
+        usage = (estimate_tokens(prompt_text), estimate_tokens(completion_text))
+    lease.complete_with_tokens(*usage)
+    _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
+            path=path, status=200, started=started,
+            prompt_tokens=usage[0], completion_tokens=usage[1],
+            client_ip=client_ip, auth=auth)
+    state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
+    return web.Response(
+        body=raw, status=200,
+        content_type="application/json",
+    )
+
+
+async def _forward_stream(
+    request, state: AppState, upstream, endpoint, model, api_kind, path,
+    started, lease, prompt_text, client_ip, auth,
+) -> web.StreamResponse:
+    """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120)."""
+    resp = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        },
+    )
+    await resp.prepare(request)
+    lease.complete()  # endpoint accepted the stream; active slot released
+    acc = StreamingTokenAccumulator()
+    status = 200
+    error = None
+    try:
+        async for chunk in upstream.content.iter_any():
+            acc.feed(chunk)
+            await resp.write(chunk)
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            ConnectionResetError) as e:
+        status, error = 502, f"stream interrupted: {type(e).__name__}"
+    finally:
+        upstream.release()
+        pt, ct, reported = acc.finalize(prompt_text)
+        duration_s = time.monotonic() - started
+        if ct > 0:
+            state.load_manager.update_tps(
+                endpoint.id, model, api_kind, ct, duration_s
+            )
+            state.events.publish(
+                "TpsUpdated",
+                {"endpoint_id": endpoint.id, "model": model,
+                 "tps": round(ct / duration_s, 2) if duration_s > 0 else None},
+            )
+        _record(state, endpoint=endpoint, model=model, api_kind=api_kind,
+                path=path, status=status, started=started, prompt_tokens=pt,
+                completion_tokens=ct, client_ip=client_ip, auth=auth,
+                error=error, stream=True)
+    return resp
+
+
+def _extract_completion_text(parsed: dict) -> str:
+    parts = []
+    for choice in parsed.get("choices") or []:
+        if not isinstance(choice, dict):
+            continue
+        msg = choice.get("message") or {}
+        if isinstance(msg.get("content"), str):
+            parts.append(msg["content"])
+        if isinstance(choice.get("text"), str):
+            parts.append(choice["text"])
+    for item in parsed.get("output") or []:  # responses API
+        if isinstance(item, dict):
+            for c in item.get("content") or []:
+                if isinstance(c, dict) and isinstance(c.get("text"), str):
+                    parts.append(c["text"])
+    return "".join(parts)
+
+
+def _chat_prompt_text(body: dict) -> str:
+    parts = []
+    for m in body.get("messages") or []:
+        if isinstance(m, dict):
+            c = m.get("content")
+            if isinstance(c, str):
+                parts.append(c)
+            elif isinstance(c, list):
+                parts.extend(
+                    p.get("text", "") for p in c if isinstance(p, dict)
+                )
+    return "\n".join(parts)
+
+
+def _completion_prompt_text(body: dict) -> str:
+    p = body.get("prompt")
+    if isinstance(p, str):
+        return p
+    if isinstance(p, list):
+        return "\n".join(str(x) for x in p)
+    return ""
+
+
+def _responses_prompt_text(body: dict) -> str:
+    i = body.get("input")
+    if isinstance(i, str):
+        return i
+    if isinstance(i, list):
+        return _chat_prompt_text({"messages": i})
+    return ""
+
+
+# ------------------------------------------------------------------ handlers
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    return await proxy_openai_post(
+        request, "/v1/chat/completions", TpsApiKind.CHAT,
+        Capability.CHAT_COMPLETION, _chat_prompt_text,
+    )
+
+
+async def completions(request: web.Request) -> web.StreamResponse:
+    return await proxy_openai_post(
+        request, "/v1/completions", TpsApiKind.COMPLETION,
+        Capability.CHAT_COMPLETION, _completion_prompt_text,
+    )
+
+
+async def embeddings(request: web.Request) -> web.StreamResponse:
+    return await proxy_openai_post(
+        request, "/v1/embeddings", TpsApiKind.EMBEDDINGS,
+        Capability.EMBEDDINGS,
+    )
+
+
+async def responses(request: web.Request) -> web.StreamResponse:
+    return await proxy_openai_post(
+        request, "/v1/responses", TpsApiKind.RESPONSES,
+        Capability.CHAT_COMPLETION, _responses_prompt_text,
+    )
+
+
+async def list_models(request: web.Request) -> web.Response:
+    """Union of canonical models across online endpoints (api/openai.rs:261)."""
+    state: AppState = request.app["state"]
+    seen: dict[str, dict] = {}
+    for ep in state.registry.list_online():
+        for m in state.registry.models_for(ep.id):
+            entry = seen.setdefault(
+                m.canonical_name,
+                {
+                    "id": m.canonical_name,
+                    "object": "model",
+                    "created": int(m.created_at),
+                    "owned_by": "llmlb",
+                    "metadata": {
+                        "endpoints": [],
+                        "capabilities": [c.value for c in m.capabilities],
+                        "context_length": m.context_length,
+                    },
+                },
+            )
+            entry["metadata"]["endpoints"].append(ep.name)
+    return web.json_response({"object": "list", "data": list(seen.values())})
+
+
+async def get_model(request: web.Request) -> web.Response:
+    state: AppState = request.app["state"]
+    model_id = request.match_info["model_id"]
+    canonical = to_canonical(model_id)
+    pairs = state.registry.find_by_model(canonical)
+    if not pairs:
+        return error_response(404, f"model {model_id!r} not found")
+    _, m = pairs[0]
+    return web.json_response(
+        {
+            "id": m.canonical_name,
+            "object": "model",
+            "created": int(m.created_at),
+            "owned_by": "llmlb",
+        }
+    )

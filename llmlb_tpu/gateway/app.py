@@ -1,0 +1,395 @@
+"""Gateway application: full route tree + middleware stack.
+
+Parity with reference api/mod.rs:70-635 (route table) with the same middleware
+order as §3.2: audit capture (outermost) → inference gate (update drain) →
+auth (JWT / API-key / Anthropic x-api-key) → handler. Dashboard SPA served
+from static files when present (the reference embeds a built React bundle).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from aiohttp import web
+
+from llmlb_tpu.gateway import (
+    api_admin,
+    api_anthropic,
+    api_benchmarks,
+    api_cloud,
+    api_dashboard,
+    api_media,
+    api_openai,
+)
+from llmlb_tpu.gateway.app_state import AppState
+from llmlb_tpu.gateway.audit import AuditEntry
+from llmlb_tpu.gateway.auth import AuthError, verify_jwt
+from llmlb_tpu.gateway.types import Permission
+
+log = logging.getLogger("llmlb_tpu.gateway.app")
+
+MAX_BODY_BYTES = 20 * 1024 * 1024  # parity: api/mod.rs:58
+
+PUBLIC_PATHS = {
+    ("POST", "/api/auth/login"),
+    ("POST", "/api/auth/register"),
+    ("GET", "/health"),
+    ("GET", "/"),
+}
+
+# method+prefix → permission required when authenticating with an API key
+_API_KEY_PERMS: list[tuple[str, str, Permission]] = [
+    ("GET", "/api/endpoints", Permission.ENDPOINTS_READ),
+    ("*", "/api/endpoints", Permission.ENDPOINTS_MANAGE),
+    ("*", "/api/users", Permission.USERS_MANAGE),
+    ("*", "/api/invitations", Permission.INVITATIONS_MANAGE),
+    ("GET", "/api/audit", Permission.LOGS_READ),
+    ("GET", "/api/dashboard", Permission.METRICS_READ),
+    ("GET", "/api/metrics", Permission.METRICS_READ),
+    ("GET", "/api/models/registry", Permission.REGISTRY_READ),
+    ("GET", "/api/benchmarks", Permission.METRICS_READ),
+]
+
+
+@web.middleware
+async def audit_middleware(request: web.Request, handler):
+    """Outermost: every request lands in the tamper-evident audit log."""
+    state: AppState = request.app["state"]
+    start = time.monotonic()
+    status = 500
+    detail = None
+    try:
+        response = await handler(request)
+        status = response.status
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    except Exception as e:
+        detail = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        if request.path != "/ws/dashboard":
+            auth = request.get("auth") or {}
+            state.audit.record(AuditEntry(
+                ts=time.time(),
+                method=request.method,
+                path=request.path,
+                status=status,
+                duration_ms=(time.monotonic() - start) * 1000.0,
+                actor=auth.get("actor"),
+                actor_type=auth.get("actor_type", "anonymous"),
+                ip=request.remote,
+                detail=detail,
+            ))
+
+
+@web.middleware
+async def gate_middleware(request: web.Request, handler):
+    """Inference gate: during update drain, /v1/* rejects with 503+Retry-After
+    (inference_gate.rs:200-230); otherwise counts the request in flight for the
+    full (streaming) response lifetime."""
+    state: AppState = request.app["state"]
+    if request.path.startswith("/v1/"):
+        if state.gate.rejecting:
+            return web.json_response(
+                {"error": {"message": "server is draining for update",
+                           "type": "server_error", "code": "draining"}},
+                status=503,
+                headers={"Retry-After": "30"},
+            )
+        with state.gate.track():
+            return await handler(request)
+    return await handler(request)
+
+
+def _required_api_key_perm(method: str, path: str) -> Permission | None:
+    for m, prefix, perm in _API_KEY_PERMS:
+        if path.startswith(prefix) and (m == "*" or m == method):
+            return perm
+    return None
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    state: AppState = request.app["state"]
+    method, path = request.method, request.path
+
+    if method == "OPTIONS" or (method, path) in PUBLIC_PATHS or path.startswith(
+        "/dashboard"
+    ):
+        return await handler(request)
+    if path == "/ws/dashboard":  # WS does its own token auth (query/cookie)
+        return await handler(request)
+
+    # ---- credential extraction
+    bearer = None
+    authz = request.headers.get("Authorization", "")
+    if authz.startswith("Bearer "):
+        bearer = authz[7:].strip()
+    anthropic_key = request.headers.get("x-api-key")  # Anthropic-style
+
+    auth_ctx: dict | None = None
+    if bearer and bearer.startswith("sk_"):
+        key = state.api_keys.verify(bearer)
+        if key:
+            auth_ctx = {
+                "actor": f"key:{key.name}", "actor_type": "api_key",
+                "api_key_id": key.id, "user_id": key.user_id,
+                "permissions": set(key.permissions), "role": None,
+            }
+    elif bearer:
+        try:
+            payload = verify_jwt(state.jwt_secret, bearer)
+            auth_ctx = {
+                "actor": payload.get("username"), "actor_type": "jwt",
+                "user_id": payload.get("sub"), "api_key_id": None,
+                "permissions": None, "role": payload.get("role"),
+            }
+        except AuthError:
+            auth_ctx = None
+    if auth_ctx is None and anthropic_key and anthropic_key.startswith("sk_"):
+        key = state.api_keys.verify(anthropic_key)
+        if key:
+            auth_ctx = {
+                "actor": f"key:{key.name}", "actor_type": "api_key",
+                "api_key_id": key.id, "user_id": key.user_id,
+                "permissions": set(key.permissions), "role": None,
+            }
+
+    if auth_ctx is None:
+        if path.startswith("/v1/"):
+            return web.json_response(
+                {"error": {"message": "missing or invalid API key",
+                           "type": "authentication_error", "code": None}},
+                status=401,
+            )
+        return web.json_response({"error": "authentication required"}, status=401)
+
+    request["auth"] = auth_ctx
+
+    # ---- authorization
+    if path.startswith("/v1/"):
+        if auth_ctx["actor_type"] == "api_key":
+            needed = (
+                Permission.OPENAI_MODELS_READ
+                if path.startswith("/v1/models") and method == "GET"
+                else Permission.OPENAI_INFERENCE
+            )
+            perms = auth_ctx["permissions"] or set()
+            if needed not in perms and Permission.OPENAI_INFERENCE not in perms:
+                return web.json_response(
+                    {"error": {"message": f"API key lacks {needed.value}",
+                               "type": "permission_error", "code": None}},
+                    status=403,
+                )
+        return await handler(request)
+
+    # /api/* surface
+    if auth_ctx["actor_type"] == "api_key":
+        needed = _required_api_key_perm(method, path)
+        if needed is None or needed not in (auth_ctx["permissions"] or set()):
+            return web.json_response(
+                {"error": f"API key lacks permission for {method} {path}"},
+                status=403,
+            )
+        return await handler(request)
+
+    # JWT: viewers read, admins everything; self-service paths exempt
+    if auth_ctx["role"] != "admin":
+        self_service = path in (
+            "/api/auth/me", "/api/auth/change-password", "/api/api-keys"
+        ) or path.startswith("/api/api-keys/")
+        if method not in ("GET", "HEAD") and not self_service:
+            return web.json_response(
+                {"error": "admin role required"}, status=403
+            )
+    return await handler(request)
+
+
+def create_app(state: AppState) -> web.Application:
+    app = web.Application(
+        client_max_size=MAX_BODY_BYTES,
+        middlewares=[audit_middleware, gate_middleware, auth_middleware],
+    )
+    app["state"] = state
+    r = app.router
+
+    # ---- OpenAI surface (api/mod.rs:523-535)
+    r.add_post("/v1/chat/completions", api_openai.chat_completions)
+    r.add_post("/v1/completions", api_openai.completions)
+    r.add_post("/v1/embeddings", api_openai.embeddings)
+    r.add_post("/v1/responses", api_openai.responses)
+    r.add_get("/v1/models", api_openai.list_models)
+    r.add_get("/v1/models/{model_id:.+}", api_openai.get_model)
+    r.add_post("/v1/audio/transcriptions", api_media.audio_transcriptions)
+    r.add_post("/v1/audio/speech", api_media.audio_speech)
+    r.add_post("/v1/images/generations", api_media.images_generations)
+    r.add_post("/v1/images/edits", api_media.images_edits)
+    r.add_post("/v1/images/variations", api_media.images_variations)
+
+    # ---- Anthropic surface (api/mod.rs:553)
+    r.add_post("/v1/messages", api_anthropic.messages)
+
+    # ---- auth
+    r.add_post("/api/auth/login", api_admin.login)
+    r.add_post("/api/auth/register", api_admin.register_with_invitation)
+    r.add_get("/api/auth/me", api_admin.me)
+    r.add_post("/api/auth/change-password", api_admin.change_password)
+
+    # ---- endpoints admin
+    r.add_get("/api/endpoints", api_admin.list_endpoints)
+    r.add_post("/api/endpoints", api_admin.create_endpoint)
+    r.add_get("/api/endpoints/{endpoint_id}", api_admin.get_endpoint)
+    r.add_put("/api/endpoints/{endpoint_id}", api_admin.update_endpoint)
+    r.add_delete("/api/endpoints/{endpoint_id}", api_admin.delete_endpoint)
+    r.add_post("/api/endpoints/{endpoint_id}/test", api_admin.test_endpoint)
+    r.add_post("/api/endpoints/{endpoint_id}/sync", api_admin.sync_endpoint)
+    r.add_get(
+        "/api/endpoints/{endpoint_id}/health",
+        api_admin.endpoint_health_history,
+    )
+
+    # ---- users / keys / invitations
+    r.add_get("/api/users", api_admin.list_users)
+    r.add_post("/api/users", api_admin.create_user)
+    r.add_delete("/api/users/{user_id}", api_admin.delete_user)
+    r.add_put("/api/users/{user_id}/role", api_admin.set_user_role)
+    r.add_get("/api/api-keys", api_admin.list_api_keys)
+    r.add_post("/api/api-keys", api_admin.create_api_key)
+    r.add_delete("/api/api-keys/{key_id}", api_admin.revoke_api_key)
+    r.add_get("/api/invitations", api_admin.list_invitations)
+    r.add_post("/api/invitations", api_admin.create_invitation)
+    r.add_delete(
+        "/api/invitations/{invitation_id}", api_admin.delete_invitation
+    )
+
+    # ---- audit / settings / system
+    r.add_get("/api/audit-log", api_admin.query_audit_log)
+    r.add_post("/api/audit-log/verify", api_admin.verify_audit_chain)
+    r.add_get("/api/dashboard/settings", api_admin.get_settings)
+    r.add_put("/api/dashboard/settings", api_admin.update_setting)
+    r.add_get("/api/system", api_admin.system_info)
+
+    # ---- dashboard data + WS
+    r.add_get("/api/dashboard/overview", api_dashboard.overview)
+    r.add_get(
+        "/api/dashboard/request-history", api_dashboard.request_history_minutes
+    )
+    r.add_get("/api/dashboard/requests", api_dashboard.request_records)
+    r.add_get(
+        "/api/dashboard/requests/{record_id}",
+        api_dashboard.request_record_detail,
+    )
+    r.add_get("/api/dashboard/token-stats", api_dashboard.token_stats)
+    r.add_get(
+        "/api/dashboard/endpoints/{endpoint_id}/stats",
+        api_dashboard.endpoint_stats,
+    )
+    r.add_get("/api/dashboard/model-tps", api_dashboard.model_tps)
+    r.add_get("/api/dashboard/clients", api_dashboard.client_analytics)
+    r.add_get("/ws/dashboard", api_dashboard.dashboard_ws)
+
+    # ---- benchmarks + cloud metrics
+    r.add_post("/api/benchmarks/tps", api_benchmarks.start_tps_benchmark)
+    r.add_get("/api/benchmarks/tps", api_benchmarks.list_tps_benchmarks)
+    r.add_get("/api/benchmarks/tps/{run_id}", api_benchmarks.get_tps_benchmark)
+    r.add_get("/api/metrics/cloud", api_cloud.cloud_metrics_handler)
+
+    # ---- update lifecycle
+    r.add_post("/api/system/update/check", _update_check)
+    r.add_post("/api/system/update/apply", _update_apply)
+    r.add_post("/api/system/update/cancel", _update_cancel)
+    r.add_put("/api/system/update/schedule", _update_schedule)
+
+    # ---- liveness + root
+    r.add_get("/health", _health)
+    r.add_get("/", _root)
+
+    # ---- dashboard SPA (static bundle, embedded in the reference binary)
+    static_dir = os.path.join(os.path.dirname(__file__), "dashboard_static")
+    if os.path.isdir(static_dir):
+        r.add_get("/dashboard", _dashboard_index)
+        r.add_get("/dashboard/{tail:.*}", _dashboard_asset)
+        app["dashboard_static"] = static_dir
+
+    async def on_shutdown(app):
+        await state.close()
+
+    app.on_shutdown.append(on_shutdown)
+    return app
+
+
+async def _health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def _root(request: web.Request) -> web.Response:
+    return web.json_response({
+        "name": "llmlb_tpu",
+        "endpoints": ["/v1/chat/completions", "/v1/responses", "/v1/models",
+                      "/v1/messages", "/api/endpoints", "/dashboard"],
+    })
+
+
+async def _dashboard_index(request: web.Request) -> web.FileResponse:
+    return web.FileResponse(
+        os.path.join(request.app["dashboard_static"], "index.html")
+    )
+
+
+async def _dashboard_asset(request: web.Request) -> web.StreamResponse:
+    static_dir = request.app["dashboard_static"]
+    tail = request.match_info["tail"] or "index.html"
+    full = os.path.normpath(os.path.join(static_dir, tail))
+    if not full.startswith(os.path.abspath(static_dir)) or not os.path.isfile(full):
+        return await _dashboard_index(request)  # SPA fallback
+    return web.FileResponse(full)
+
+
+async def _update_check(request: web.Request) -> web.Response:
+    state: AppState = request.app["state"]
+    if state.update_manager is None:
+        return web.json_response({"error": "updates not configured"}, status=501)
+    return web.json_response(await state.update_manager.check())
+
+
+async def _update_apply(request: web.Request) -> web.Response:
+    from llmlb_tpu.gateway.update import ApplyMode
+
+    state: AppState = request.app["state"]
+    if state.update_manager is None:
+        return web.json_response({"error": "updates not configured"}, status=501)
+    try:
+        body = await request.json() if request.can_read_body else {}
+    except Exception:
+        body = {}
+    mode = ApplyMode.FORCE if body.get("force") else ApplyMode.NORMAL
+    started = state.update_manager.request_apply(mode)
+    return web.json_response(
+        {"applying": started, **state.update_manager.status()},
+        status=202 if started else 409,
+    )
+
+
+async def _update_cancel(request: web.Request) -> web.Response:
+    state: AppState = request.app["state"]
+    if state.update_manager is None:
+        return web.json_response({"error": "updates not configured"}, status=501)
+    return web.json_response({"cancelled": state.update_manager.cancel_drain()})
+
+
+async def _update_schedule(request: web.Request) -> web.Response:
+    state: AppState = request.app["state"]
+    if state.update_manager is None:
+        return web.json_response({"error": "updates not configured"}, status=501)
+    try:
+        body = await request.json()
+        state.update_manager.set_schedule(
+            body.get("mode", "immediate"), body.get("at_time")
+        )
+    except Exception as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response(state.update_manager.status())

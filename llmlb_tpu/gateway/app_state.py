@@ -1,0 +1,205 @@
+"""AppState: shared state injected into all handlers + bootstrap sequence.
+
+Parity with reference lib.rs:106-141 (AppState) and bootstrap.rs:42-345
+(initialize): DB + schema, registry cache load, LoadManager seeding from daily
+stats, shared HTTP client, admin bootstrap, JWT secret provisioning, audit init
++ startup chain verification, health checker, background maintenance tasks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime
+import logging
+import secrets
+import time
+
+import aiohttp
+
+from llmlb_tpu.gateway.audit import AuditLog
+from llmlb_tpu.gateway.auth import (
+    ApiKeyStore,
+    InvitationStore,
+    UserStore,
+    ensure_admin_exists,
+)
+from llmlb_tpu.gateway.balancer import LoadManager
+from llmlb_tpu.gateway.config import QueueConfig, ServerConfig
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.gate import InferenceGate
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.registry import EndpointRegistry
+from llmlb_tpu.gateway.types import TpsApiKind
+
+log = logging.getLogger("llmlb_tpu.gateway")
+
+
+@dataclasses.dataclass
+class AppState:
+    config: ServerConfig
+    db: Database
+    registry: EndpointRegistry
+    load_manager: LoadManager
+    events: DashboardEventBus
+    gate: InferenceGate
+    audit: AuditLog
+    users: UserStore
+    api_keys: ApiKeyStore
+    invitations: InvitationStore
+    jwt_secret: str
+    http: aiohttp.ClientSession
+    health_checker: EndpointHealthChecker | None = None
+    update_manager: object | None = None  # set by gateway.update
+    started_at: float = dataclasses.field(default_factory=time.time)
+    _tasks: list[asyncio.Task] = dataclasses.field(default_factory=list)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.health_checker:
+            await self.health_checker.stop()
+        await self.audit.stop()
+        await self.http.close()
+        self.db.close()
+
+
+async def build_app_state(
+    config: ServerConfig | None = None,
+    *,
+    db: Database | None = None,
+    start_background: bool = True,
+) -> AppState:
+    config = config or ServerConfig.from_env()
+    if db is None:
+        db = Database(config.database_url or ":memory:")
+
+    registry = EndpointRegistry(db)
+    load_manager = LoadManager(QueueConfig.from_env())
+    events = DashboardEventBus()
+    gate = InferenceGate()
+    audit = AuditLog(db)
+
+    users = UserStore(db)
+    api_keys = ApiKeyStore(db)
+    invitations = InvitationStore(db)
+
+    # admin bootstrap (reference auth/bootstrap.rs)
+    admin, generated = ensure_admin_exists(
+        users, config.admin_username, config.admin_password
+    )
+    if generated:
+        log.warning(
+            "bootstrap admin %r created with generated password: %s "
+            "(change it on first login)",
+            admin.username, generated,
+        )
+
+    # JWT secret: env > persisted setting > fresh random (persisted)
+    jwt_secret = config.jwt_secret or db.get_setting("auth.jwt_secret")
+    if not jwt_secret:
+        jwt_secret = secrets.token_urlsafe(32)
+        db.set_setting("auth.jwt_secret", jwt_secret)
+
+    # startup audit chain verification (bootstrap.rs:211-265)
+    ok, err = audit.verify()
+    if not ok:
+        log.error("AUDIT CHAIN VERIFICATION FAILED: %s", err)
+
+    http = aiohttp.ClientSession(
+        connector=aiohttp.TCPConnector(limit_per_host=32, keepalive_timeout=60)
+    )
+
+    state = AppState(
+        config=config, db=db, registry=registry, load_manager=load_manager,
+        events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
+        invitations=invitations, jwt_secret=jwt_secret, http=http,
+    )
+
+    _seed_tps_from_daily_stats(state)
+
+    if start_background:
+        audit.start()
+        checker = EndpointHealthChecker(
+            registry, load_manager, db, http, events,
+            interval_s=config.health_check_interval_s,
+            timeout_s=config.health_check_timeout_s,
+        )
+        checker.start()
+        state.health_checker = checker
+        state._tasks.append(
+            asyncio.create_task(_maintenance_loop(state), name="gw-maintenance")
+        )
+    return state
+
+
+def _seed_tps_from_daily_stats(state: AppState) -> None:
+    """Warm-start the TPS tracker from today's persisted stats
+    (bootstrap.rs:142-159)."""
+    today = datetime.date.today().isoformat()
+    rows = state.db.query(
+        """SELECT endpoint_id, model, api_kind, completion_tokens,
+                  total_duration_ms, request_count
+           FROM endpoint_daily_stats WHERE date=? AND request_count>0""",
+        (today,),
+    )
+    for r in rows:
+        if r["total_duration_ms"] and r["completion_tokens"]:
+            tps = r["completion_tokens"] / (r["total_duration_ms"] / 1000.0)
+            try:
+                kind = TpsApiKind(r["api_kind"])
+            except ValueError:
+                kind = TpsApiKind.OTHER
+            state.load_manager.seed_tps(
+                r["endpoint_id"], r["model"], kind, tps,
+                samples=r["request_count"],
+            )
+
+
+async def _maintenance_loop(state: AppState) -> None:
+    """Hourly: request-history retention cleanup + periodic audit verify
+    (reference: cleanup task bootstrap.rs:161, audit verify :211-265)."""
+    while True:
+        await asyncio.sleep(3600)
+        try:
+            cutoff = time.time() - state.config.request_history_retention_days * 86400
+            state.db.execute("DELETE FROM request_history WHERE ts < ?", (cutoff,))
+            ok, err = state.audit.verify()
+            if not ok:
+                log.error("periodic audit verification failed: %s", err)
+        except Exception:
+            log.exception("maintenance cycle failed")
+
+
+def record_daily_stat(
+    state: AppState,
+    endpoint_id: str,
+    model: str,
+    api_kind: TpsApiKind,
+    *,
+    error: bool = False,
+    prompt_tokens: int = 0,
+    completion_tokens: int = 0,
+    duration_ms: float = 0.0,
+) -> None:
+    today = datetime.date.today().isoformat()
+    state.db.execute(
+        """INSERT INTO endpoint_daily_stats
+           (endpoint_id, date, model, api_kind, request_count, error_count,
+            prompt_tokens, completion_tokens, total_duration_ms)
+           VALUES (?,?,?,?,1,?,?,?,?)
+           ON CONFLICT(endpoint_id, date, model, api_kind) DO UPDATE SET
+               request_count = request_count + 1,
+               error_count = error_count + excluded.error_count,
+               prompt_tokens = prompt_tokens + excluded.prompt_tokens,
+               completion_tokens = completion_tokens + excluded.completion_tokens,
+               total_duration_ms = total_duration_ms + excluded.total_duration_ms""",
+        (endpoint_id, today, model, api_kind.value, int(error),
+         prompt_tokens, completion_tokens, duration_ms),
+    )

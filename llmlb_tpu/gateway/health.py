@@ -1,0 +1,218 @@
+"""Pull-based endpoint health checker.
+
+State machine parity with reference health/endpoint_checker.rs: default 30 s
+interval (:43), 5 s probe timeout (:40), offline after 2 consecutive failures
+(:46), pending→offline immediately on first failure (:580); on recovery the
+type is re-detected and models auto-synced (:333-377,:426); TPS state cleared on
+failure so recovered endpoints re-learn (:313-317); every check persisted.
+
+TPU extension: tpu/xllm endpoints are probed at /api/health and their chip/HBM
+telemetry flows into the registry (the reference read GPU fields, :515).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import aiohttp
+
+from llmlb_tpu.gateway.balancer import LoadManager
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.detection import detect_endpoint_type
+from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.model_sync import sync_endpoint_models
+from llmlb_tpu.gateway.registry import EndpointRegistry
+from llmlb_tpu.gateway.types import (
+    AcceleratorInfo,
+    Endpoint,
+    EndpointStatus,
+    EndpointType,
+    HealthCheckResult,
+)
+
+log = logging.getLogger("llmlb_tpu.gateway.health")
+
+OFFLINE_AFTER_FAILURES = 2  # parity: endpoint_checker.rs:46
+
+
+class EndpointHealthChecker:
+    def __init__(
+        self,
+        registry: EndpointRegistry,
+        load_manager: LoadManager,
+        db: Database,
+        session: aiohttp.ClientSession,
+        events: DashboardEventBus | None = None,
+        interval_s: float = 30.0,
+        timeout_s: float = 5.0,
+    ):
+        self.registry = registry
+        self.load_manager = load_manager
+        self.db = db
+        self.session = session
+        self.events = events
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._monitor_loop(), name="health-checker")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            try:
+                await self.check_all()
+            except Exception:
+                log.exception("health check cycle failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def check_all(self) -> list[HealthCheckResult]:
+        endpoints = self.registry.list_all()
+        if not endpoints:
+            return []
+        return list(
+            await asyncio.gather(*(self.check_endpoint(ep) for ep in endpoints))
+        )
+
+    # ------------------------------------------------------------------ probe
+
+    async def _probe(self, ep: Endpoint) -> HealthCheckResult:
+        """One HTTP probe. tpu/xllm: /api/health (telemetry) with /v1/models
+        fallback; everything else: /v1/models."""
+        headers = {}
+        if ep.api_key:
+            headers["Authorization"] = f"Bearer {ep.api_key}"
+        start = time.monotonic()
+
+        async def get(path: str) -> tuple[int, dict | None]:
+            async with self.session.get(
+                ep.url + path,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+            ) as resp:
+                try:
+                    body = await resp.json(content_type=None)
+                except Exception:
+                    body = None
+                return resp.status, body if isinstance(body, dict) else None
+
+        try:
+            accelerator = None
+            models_payload = None
+            if ep.endpoint_type in (EndpointType.TPU, EndpointType.XLLM):
+                try:
+                    status, body = await get("/api/health")
+                except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                    status, body = 0, None
+                if status == 200 and body:
+                    tpu = body.get("tpu") or body.get("gpu") or {}
+                    accelerator = AcceleratorInfo(
+                        accelerator=tpu.get("accelerator")
+                        or ("tpu" if "tpu" in body else None),
+                        chip_count=int(tpu.get("chip_count", 0)),
+                        hbm_used_bytes=int(tpu.get("hbm_used_bytes", 0)),
+                        hbm_total_bytes=int(tpu.get("hbm_total_bytes", 0)),
+                        utilization=tpu.get("utilization"),
+                    )
+                else:
+                    status, models_payload = await get("/v1/models")
+            else:
+                status, models_payload = await get("/v1/models")
+
+            latency_ms = (time.monotonic() - start) * 1000.0
+            ok = status == 200
+            return HealthCheckResult(
+                endpoint_id=ep.id, ok=ok, latency_ms=latency_ms,
+                error=None if ok else f"HTTP {status}",
+                accelerator=accelerator, models_payload=models_payload,
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return HealthCheckResult(
+                endpoint_id=ep.id, ok=False,
+                latency_ms=(time.monotonic() - start) * 1000.0,
+                error=f"{type(e).__name__}: {e}",
+            )
+
+    # ------------------------------------------------------------ state logic
+
+    async def check_endpoint(self, ep: Endpoint) -> HealthCheckResult:
+        result = await self._probe(ep)
+        prev_status = ep.status
+
+        if result.ok:
+            recovered = prev_status in (
+                EndpointStatus.OFFLINE, EndpointStatus.ERROR, EndpointStatus.PENDING
+            )
+            self.registry.update_status(
+                ep.id, EndpointStatus.ONLINE,
+                latency_ms=result.latency_ms,
+                accelerator=result.accelerator,
+                consecutive_failures=0,
+            )
+            if recovered:
+                await self._on_recovery(ep)
+        else:
+            failures = ep.consecutive_failures + 1
+            if prev_status == EndpointStatus.PENDING:
+                new_status = EndpointStatus.OFFLINE  # pending fails fast (:580)
+            elif failures >= OFFLINE_AFTER_FAILURES:
+                new_status = EndpointStatus.OFFLINE
+            else:
+                new_status = prev_status  # one strike: stay online
+            self.registry.update_status(
+                ep.id, new_status, consecutive_failures=failures
+            )
+            if new_status == EndpointStatus.OFFLINE:
+                # recovered endpoints must re-measure TPS (:313-317)
+                self.load_manager.clear_tps_for_endpoint(ep.id)
+
+        self.db.record_health_check(
+            ep.id, result.ok, result.latency_ms, result.error, result.checked_at
+        )
+        new_ep = self.registry.get(ep.id)
+        if self.events and new_ep and new_ep.status != prev_status:
+            self.events.publish(
+                "EndpointStatusChanged",
+                {
+                    "endpoint_id": ep.id,
+                    "name": ep.name,
+                    "from": prev_status.value,
+                    "to": new_ep.status.value,
+                },
+            )
+        if self.events and result.accelerator:
+            self.events.publish(
+                "TelemetryUpdated",
+                {"endpoint_id": ep.id, "tpu": vars(result.accelerator)},
+            )
+        return result
+
+    async def _on_recovery(self, ep: Endpoint) -> None:
+        """Re-detect type (it may have been swapped) and resync models."""
+        try:
+            detected = await detect_endpoint_type(
+                ep.base_url, self.session, timeout=self.timeout_s, api_key=ep.api_key
+            )
+            if detected != ep.endpoint_type:
+                log.info(
+                    "endpoint %s type changed %s -> %s",
+                    ep.name, ep.endpoint_type.value, detected.value,
+                )
+                self.registry.update_type(ep.id, detected)
+                ep.endpoint_type = detected
+        except Exception:
+            pass
+        try:
+            await sync_endpoint_models(ep, self.registry, self.session)
+        except Exception as e:
+            log.warning("model sync on recovery failed for %s: %s", ep.name, e)

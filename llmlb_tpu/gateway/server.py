@@ -1,0 +1,100 @@
+"""Gateway server entry: bootstrap state, serve, graceful shutdown.
+
+Parity with reference server.rs (axum serve + graceful shutdown on signals)
+and main.rs/cli (serve/stop/status subcommands; the single-instance lock lives
+in lock.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from aiohttp import web
+
+from llmlb_tpu.gateway.app import create_app
+from llmlb_tpu.gateway.app_state import build_app_state
+from llmlb_tpu.gateway.config import ServerConfig
+from llmlb_tpu.gateway.gate import InferenceGate  # noqa: F401  (re-export)
+from llmlb_tpu.gateway.lock import ServerLock
+from llmlb_tpu.gateway.update import UpdateManager
+
+log = logging.getLogger("llmlb_tpu.gateway.server")
+
+
+async def run_server(config: ServerConfig | None = None) -> None:
+    config = config or ServerConfig.from_env()
+    os.makedirs(os.path.dirname(config.database_url) or ".", exist_ok=True)
+
+    lock = ServerLock.acquire(config.port)
+    state = await build_app_state(config)
+    state.update_manager = UpdateManager(
+        state.gate, state.events, drain_timeout_s=config.update_drain_timeout_s
+    )
+    app = create_app(state)
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, config.host, config.port)
+    await site.start()
+    log.info("llmlb_tpu gateway listening on %s:%d", config.host, config.port)
+
+    stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop_event.set)
+        except NotImplementedError:
+            pass
+    try:
+        await stop_event.wait()
+    finally:
+        log.info("shutting down")
+        await runner.cleanup()
+        lock.release()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="llmlb", description="TPU-native LLM gateway")
+    sub = parser.add_subparsers(dest="command")
+
+    serve = sub.add_parser("serve", help="run the gateway")
+    serve.add_argument("--host", default=None)
+    serve.add_argument("--port", type=int, default=None)
+
+    sub.add_parser("status", help="check whether a gateway is running")
+    stop = sub.add_parser("stop", help="stop a running gateway")
+    stop.add_argument("--port", type=int, default=None)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=os.environ.get("LLMLB_LOG_LEVEL", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    config = ServerConfig.from_env()
+    if getattr(args, "host", None):
+        config = config.__class__(**{**config.__dict__, "host": args.host})
+    if getattr(args, "port", None):
+        config = config.__class__(**{**config.__dict__, "port": args.port})
+
+    if args.command in (None, "serve"):
+        asyncio.run(run_server(config))
+    elif args.command == "status":
+        info = ServerLock.status(config.port)
+        if info:
+            print(f"running: pid={info['pid']} port={info['port']}")
+        else:
+            print("not running")
+    elif args.command == "stop":
+        if ServerLock.stop(config.port):
+            print("stopped")
+        else:
+            print("not running")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Core gateway domain types.
+
+Counterpart of the reference's types/endpoint.rs + common/auth.rs, re-designed:
+the `TPU` endpoint type is first-class (detection priority #1) and telemetry
+fields are accelerator-generic (chip/HBM) rather than CUDA-specific
+(reference types/endpoint.rs:308-379 carries GPU VRAM fields).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+import uuid
+
+
+class EndpointType(str, enum.Enum):
+    TPU = "tpu"  # in-tree JAX engine — ours, probed first
+    XLLM = "xllm"
+    OLLAMA = "ollama"
+    VLLM = "vllm"
+    LM_STUDIO = "lm_studio"
+    LLAMA_CPP = "llama_cpp"
+    OPENAI_COMPATIBLE = "openai_compatible"
+
+
+class EndpointStatus(str, enum.Enum):
+    PENDING = "pending"
+    ONLINE = "online"
+    OFFLINE = "offline"
+    ERROR = "error"
+
+
+class Capability(str, enum.Enum):
+    CHAT_COMPLETION = "chat_completion"
+    EMBEDDINGS = "embeddings"
+    IMAGE_GENERATION = "image_generation"
+    AUDIO_TRANSCRIPTION = "audio_transcription"
+    AUDIO_SPEECH = "audio_speech"
+
+
+class Role(str, enum.Enum):
+    ADMIN = "admin"
+    VIEWER = "viewer"
+
+
+class Permission(str, enum.Enum):
+    """API-key permission scopes (parity: reference common/auth.rs:59-97)."""
+
+    OPENAI_INFERENCE = "openai.inference"
+    OPENAI_MODELS_READ = "openai.models.read"
+    ENDPOINTS_READ = "endpoints.read"
+    ENDPOINTS_MANAGE = "endpoints.manage"
+    USERS_MANAGE = "users.manage"
+    INVITATIONS_MANAGE = "invitations.manage"
+    LOGS_READ = "logs.read"
+    METRICS_READ = "metrics.read"
+    REGISTRY_READ = "registry.read"
+
+
+class TpsApiKind(str, enum.Enum):
+    """Which API family a TPS measurement belongs to."""
+
+    CHAT = "chat"
+    COMPLETION = "completion"
+    RESPONSES = "responses"
+    EMBEDDINGS = "embeddings"
+    OTHER = "other"
+
+
+@dataclasses.dataclass
+class AcceleratorInfo:
+    """Chip/HBM telemetry reported by an endpoint's health probe."""
+
+    accelerator: str | None = None  # "tpu" | "gpu" | ...
+    chip_count: int = 0
+    hbm_used_bytes: int = 0
+    hbm_total_bytes: int = 0
+    utilization: float | None = None
+
+
+@dataclasses.dataclass
+class Endpoint:
+    name: str
+    base_url: str
+    id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
+    api_key: str | None = None
+    endpoint_type: EndpointType = EndpointType.OPENAI_COMPATIBLE
+    status: EndpointStatus = EndpointStatus.PENDING
+    latency_ms: float | None = None
+    consecutive_failures: int = 0
+    accelerator: AcceleratorInfo = dataclasses.field(default_factory=AcceleratorInfo)
+    created_at: float = dataclasses.field(default_factory=time.time)
+    updated_at: float = dataclasses.field(default_factory=time.time)
+    last_checked_at: float | None = None
+
+    @property
+    def url(self) -> str:
+        return self.base_url.rstrip("/")
+
+
+@dataclasses.dataclass
+class EndpointModel:
+    endpoint_id: str
+    model_id: str  # engine-local name (e.g. "llama3:8b" on ollama)
+    canonical_name: str  # canonical name exposed by the gateway
+    capabilities: list[Capability] = dataclasses.field(
+        default_factory=lambda: [Capability.CHAT_COMPLETION]
+    )
+    context_length: int | None = None
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class HealthCheckResult:
+    endpoint_id: str
+    ok: bool
+    latency_ms: float | None
+    error: str | None = None
+    accelerator: AcceleratorInfo | None = None
+    models_payload: dict | None = None  # /v1/models body captured by the probe
+    checked_at: float = dataclasses.field(default_factory=time.time)

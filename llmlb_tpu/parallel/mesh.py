@@ -56,20 +56,9 @@ def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     return Mesh(dev_array, axis_names=("dp", "tp"))
 
 
-def largest_pow2_tp(n_devices: int, num_kv_heads: int) -> int:
-    """Largest power-of-two tp degree that divides both devices and kv heads."""
-    tp = 1
-    while (
-        tp * 2 <= n_devices
-        and n_devices % (tp * 2) == 0
-        and num_kv_heads % (tp * 2) == 0
-    ):
-        tp *= 2
-    return tp
-
-
 def default_tp(n_devices: int, num_heads: int, num_kv_heads: int) -> int:
-    """Largest valid power-of-two tp degree for a model (kv replication allowed)."""
+    """Largest valid power-of-two tp degree for a model. kv heads may be
+    replicated (tp a multiple of kv_heads) when tp exceeds the kv head count."""
     tp = 1
     while True:
         cand = tp * 2

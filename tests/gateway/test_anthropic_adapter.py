@@ -1,0 +1,167 @@
+"""Anthropic /v1/messages adapter: conversion both ways + SSE transform."""
+
+import asyncio
+import json
+
+from llmlb_tpu.gateway.api_anthropic import (
+    AnthropicStreamEncoder,
+    anthropic_request_to_openai,
+    openai_response_to_anthropic,
+)
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+
+def test_request_conversion_messages_and_system():
+    body = {
+        "model": "m", "max_tokens": 50,
+        "system": "be helpful",
+        "messages": [
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": [
+                {"type": "text", "text": "hello"},
+                {"type": "tool_use", "id": "tu1", "name": "get_weather",
+                 "input": {"city": "SF"}},
+            ]},
+            {"role": "user", "content": [
+                {"type": "tool_result", "tool_use_id": "tu1",
+                 "content": [{"type": "text", "text": "sunny"}]},
+            ]},
+        ],
+        "stop_sequences": ["END"],
+        "temperature": 0.5,
+        "tools": [{"name": "get_weather", "description": "w",
+                   "input_schema": {"type": "object"}}],
+        "tool_choice": {"type": "auto"},
+    }
+    out = anthropic_request_to_openai(body)
+    assert out["messages"][0] == {"role": "system", "content": "be helpful"}
+    assert out["messages"][1] == {"role": "user", "content": "hi"}
+    asst = out["messages"][2]
+    assert asst["role"] == "assistant"
+    assert asst["tool_calls"][0]["function"]["name"] == "get_weather"
+    assert json.loads(asst["tool_calls"][0]["function"]["arguments"]) == {
+        "city": "SF"}
+    tool_msg = out["messages"][3]
+    assert tool_msg["role"] == "tool" and tool_msg["content"] == "sunny"
+    assert out["stop"] == ["END"]
+    assert out["tools"][0]["function"]["name"] == "get_weather"
+    assert out["tool_choice"] == "auto"
+
+
+def test_response_conversion_with_tool_calls():
+    openai_resp = {
+        "choices": [{
+            "finish_reason": "tool_calls",
+            "message": {
+                "role": "assistant", "content": "let me check",
+                "tool_calls": [{
+                    "id": "call_1", "type": "function",
+                    "function": {"name": "f", "arguments": '{"a": 1}'},
+                }],
+            },
+        }],
+        "usage": {"prompt_tokens": 10, "completion_tokens": 4},
+    }
+    out = openai_response_to_anthropic(openai_resp, "m")
+    assert out["stop_reason"] == "tool_use"
+    types = [b["type"] for b in out["content"]]
+    assert types == ["text", "tool_use"]
+    assert out["content"][1]["input"] == {"a": 1}
+    assert out["usage"] == {"input_tokens": 10, "output_tokens": 4}
+
+
+def test_stream_encoder_event_sequence():
+    enc = AnthropicStreamEncoder("m")
+    events = []
+
+    def names(bs):
+        return [
+            line.split(": ", 1)[1]
+            for b in bs
+            for line in b.decode().splitlines()
+            if line.startswith("event: ")
+        ]
+
+    events += names(enc.feed({
+        "choices": [{"delta": {"role": "assistant", "content": "he"}}]}))
+    events += names(enc.feed({"choices": [{"delta": {"content": "y"}}]}))
+    events += names(enc.feed({
+        "choices": [{"delta": {"tool_calls": [{
+            "index": 0, "id": "c1",
+            "function": {"name": "f", "arguments": ""}}]}}]}))
+    events += names(enc.feed({
+        "choices": [{"delta": {"tool_calls": [{
+            "index": 0, "function": {"arguments": '{"x":1}'}}]},
+            "finish_reason": "tool_calls"}]}))
+    events += names(enc.feed({
+        "choices": [], "usage": {"prompt_tokens": 5, "completion_tokens": 3}}))
+    events += names(enc.finish())
+
+    assert events[0] == "message_start"
+    assert "content_block_start" in events
+    assert "content_block_delta" in events
+    # text block closes before tool_use block opens
+    first_stop = events.index("content_block_stop")
+    second_start = events.index("content_block_start", first_stop)
+    assert second_start > first_stop
+    assert events[-2:] == ["message_delta", "message_stop"]
+
+
+def test_messages_endpoint_non_stream_and_stream():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="mock-model").start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+
+            # non-stream
+            r = await gw.client.post("/v1/messages", json={
+                "model": "mock-model", "max_tokens": 32,
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["type"] == "message"
+            assert body["role"] == "assistant"
+            assert body["content"][0]["type"] == "text"
+            assert body["usage"]["output_tokens"] == 5
+            assert body["stop_reason"] == "end_turn"
+
+            # validation: max_tokens required
+            r = await gw.client.post("/v1/messages", json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 400
+            assert (await r.json())["type"] == "error"
+
+            # stream: full anthropic event sequence
+            r = await gw.client.post("/v1/messages", json={
+                "model": "mock-model", "max_tokens": 32, "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            event_names = [l.split(": ", 1)[1] for l in raw.splitlines()
+                           if l.startswith("event: ")]
+            assert event_names[0] == "message_start"
+            assert "content_block_delta" in event_names
+            assert event_names[-1] == "message_stop"
+            # usage flowed into message_delta
+            deltas = [json.loads(l[6:]) for l in raw.splitlines()
+                      if l.startswith("data: ")]
+            md = [d for d in deltas if d.get("type") == "message_delta"][0]
+            assert md["usage"]["output_tokens"] == 5
+
+            # x-api-key header auth (Anthropic SDK style)
+            key = await gw.inference_key()
+            r = await gw.client.post("/v1/messages", json={
+                "model": "mock-model", "max_tokens": 8,
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers={"x-api-key": key})
+            assert r.status == 200
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())

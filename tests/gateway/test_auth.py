@@ -1,0 +1,109 @@
+"""Auth: JWT sign/verify, password policy, API keys, invitations, bootstrap."""
+
+import time
+
+import pytest
+
+from llmlb_tpu.gateway.auth import (
+    ApiKeyStore,
+    AuthError,
+    InvitationStore,
+    UserStore,
+    create_jwt,
+    ensure_admin_exists,
+    hash_password,
+    validate_password_policy,
+    verify_jwt,
+    verify_password,
+)
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.types import Permission, Role
+
+
+@pytest.fixture
+def db():
+    return Database(":memory:")
+
+
+def test_jwt_roundtrip():
+    token = create_jwt("secret", "u1", "alice", Role.ADMIN)
+    payload = verify_jwt("secret", token)
+    assert payload["sub"] == "u1"
+    assert payload["role"] == "admin"
+
+
+def test_jwt_bad_signature_and_expiry():
+    token = create_jwt("secret", "u1", "alice", Role.VIEWER)
+    with pytest.raises(AuthError):
+        verify_jwt("other-secret", token)
+    expired = create_jwt("secret", "u1", "alice", Role.VIEWER,
+                         ttl_s=10, now=time.time() - 100)
+    with pytest.raises(AuthError):
+        verify_jwt("secret", expired)
+    with pytest.raises(AuthError):
+        verify_jwt("secret", "not.a.token")
+    # alg tampering (e.g. alg=none) must be rejected
+    import base64, json
+    header = base64.urlsafe_b64encode(
+        json.dumps({"alg": "none", "typ": "JWT"}).encode()
+    ).rstrip(b"=").decode()
+    parts = token.split(".")
+    with pytest.raises(AuthError):
+        verify_jwt("secret", f"{header}.{parts[1]}.{parts[2]}")
+
+
+def test_password_hash_and_policy():
+    h = hash_password("s3cretpw1")
+    assert verify_password(h, "s3cretpw1")
+    assert not verify_password(h, "wrong")
+    with pytest.raises(AuthError):
+        validate_password_policy("short1")
+    with pytest.raises(AuthError):
+        validate_password_policy("nodigitshere")
+    validate_password_policy("goodpass1")
+
+
+def test_user_store_and_bootstrap_admin(db):
+    users = UserStore(db)
+    admin, generated = ensure_admin_exists(users)
+    assert generated is not None
+    assert admin.role == Role.ADMIN
+    # second call: no-op
+    again, gen2 = ensure_admin_exists(users)
+    assert gen2 is None and again.id == admin.id
+    assert users.authenticate("admin", generated).id == admin.id
+    assert users.authenticate("admin", "wrong") is None
+
+    users.change_password(admin.id, "newpass99")
+    assert users.authenticate("admin", "newpass99") is not None
+    assert not users.get(admin.id).must_change_password
+
+
+def test_api_keys(db):
+    users = UserStore(db)
+    u = users.create("bob", "password1", Role.VIEWER)
+    keys = ApiKeyStore(db)
+    record, raw = keys.create(u.id, "test", [Permission.OPENAI_INFERENCE])
+    assert raw.startswith("sk_")
+    verified = keys.verify(raw)
+    assert verified is not None
+    assert Permission.OPENAI_INFERENCE in verified.permissions
+    assert keys.verify("sk_bogus") is None
+    keys.revoke(record.id)
+    assert keys.verify(raw) is None
+    # expired key
+    _, raw2 = keys.create(u.id, "old", [], expires_at=time.time() - 10)
+    assert keys.verify(raw2) is None
+
+
+def test_invitations(db):
+    users = UserStore(db)
+    admin = users.create("root", "password1", Role.ADMIN)
+    invs = InvitationStore(db)
+    inv = invs.create(admin.id, Role.VIEWER)
+    new_user = invs.redeem(inv["code"], "carol", "password1", users)
+    assert new_user.role == Role.VIEWER
+    with pytest.raises(AuthError):
+        invs.redeem(inv["code"], "dave", "password1", users)  # reuse
+    with pytest.raises(AuthError):
+        invs.redeem("nope", "dave", "password1", users)

@@ -1,0 +1,110 @@
+"""LoadManager: TPS EMA, selection order, leases, history buckets."""
+
+import gc
+import time
+
+from llmlb_tpu.gateway.balancer import (
+    TPS_EMA_ALPHA,
+    LoadManager,
+    ModelTpsState,
+    RequestRecord,
+)
+from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
+
+
+def ep(name: str) -> Endpoint:
+    return Endpoint(name=name, base_url=f"http://{name}:1234")
+
+
+def test_ema_alpha():
+    s = ModelTpsState()
+    s.update(100, 1.0)  # first sample: exact
+    assert s.ema_tps == 100.0
+    s.update(200, 1.0)
+    assert abs(s.ema_tps - (TPS_EMA_ALPHA * 200 + (1 - TPS_EMA_ALPHA) * 100)) < 1e-9
+    s.update(0, 1.0)  # zero tokens ignored
+    assert s.samples == 2
+
+
+def test_selection_prefers_higher_tps_and_probes_unmeasured():
+    lm = LoadManager()
+    a, b, c = ep("a"), ep("b"), ep("c")
+    lm.update_tps(a.id, "m", TpsApiKind.CHAT, 100, 1.0)  # 100 tps
+    lm.update_tps(b.id, "m", TpsApiKind.CHAT, 300, 1.0)  # 300 tps
+    # c unmeasured -> +inf score, must be probed first
+    assert lm.select_endpoint([a, b, c], "m") is c
+    lm.update_tps(c.id, "m", TpsApiKind.CHAT, 10, 1.0)
+    assert lm.select_endpoint([a, b, c], "m") is b
+
+
+def test_round_robin_tie_break():
+    lm = LoadManager()
+    endpoints = [ep("a"), ep("b"), ep("c")]  # all unmeasured: tie
+    picks = [lm.select_endpoint(endpoints, "m").name for _ in range(6)]
+    assert picks == ["a", "b", "c", "a", "b", "c"]
+
+
+def test_admission_cap_excludes_full_endpoints():
+    lm = LoadManager(QueueConfig(max_active_per_endpoint=2))
+    a, b = ep("a"), ep("b")
+    leases = [lm.begin_request(a, "m", TpsApiKind.CHAT) for _ in range(2)]
+    assert lm.select_endpoint([a, b], "m") is b
+    lease_b = [lm.begin_request(b, "m", TpsApiKind.CHAT) for _ in range(2)]
+    assert lm.select_endpoint([a, b], "m") is None
+    leases[0].complete()
+    assert lm.select_endpoint([a, b], "m") is a
+    for l in leases[1:] + lease_b:
+        l.fail()
+
+
+def test_lease_complete_with_tokens_updates_tps():
+    lm = LoadManager()
+    a = ep("a")
+    lease = lm.begin_request(a, "m", TpsApiKind.CHAT)
+    assert lm.active_count(a.id) == 1
+    lease.complete_with_tokens(10, 50)
+    assert lm.active_count(a.id) == 0
+    assert lm.get_tps(a.id, "m", TpsApiKind.CHAT) is not None
+
+
+def test_lease_drop_releases():
+    lm = LoadManager()
+    a = ep("a")
+    lease = lm.begin_request(a, "m", TpsApiKind.CHAT)
+    assert lm.active_count(a.id) == 1
+    del lease
+    gc.collect()
+    assert lm.active_count(a.id) == 0
+
+
+def test_double_release_is_idempotent():
+    lm = LoadManager()
+    a = ep("a")
+    lease = lm.begin_request(a, "m", TpsApiKind.CHAT)
+    lease.complete()
+    lease.fail()
+    assert lm.active_count(a.id) == 0
+
+
+def test_clear_tps_for_endpoint():
+    lm = LoadManager()
+    a = ep("a")
+    lm.update_tps(a.id, "m", TpsApiKind.CHAT, 100, 1.0)
+    lm.clear_tps_for_endpoint(a.id)
+    assert lm.get_tps(a.id, "m", TpsApiKind.CHAT) is None
+
+
+def test_history_minute_buckets():
+    lm = LoadManager()
+    now = time.time()
+    for i in range(5):
+        lm.record_request(RequestRecord(
+            ts=now, endpoint_id="e", model="m", api_kind=TpsApiKind.CHAT,
+            status_code=200 if i % 2 == 0 else 500, duration_ms=10,
+            prompt_tokens=5, completion_tokens=7,
+        ))
+    buckets = lm.history_minute_buckets()
+    assert sum(b["requests"] for b in buckets) == 5
+    assert sum(b["errors"] for b in buckets) == 2
+    assert sum(b["completion_tokens"] for b in buckets) == 35

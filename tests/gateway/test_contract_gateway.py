@@ -1,0 +1,388 @@
+"""Gateway contract tests: API shapes through the real middleware stack.
+
+Mirrors the reference's contract tier (llmlb/tests/contract/, SURVEY.md §4):
+real app + in-memory DB + mock upstream endpoints.
+"""
+
+import asyncio
+import json
+
+from tests.support import MockOpenAIEndpoint, GatewayHarness
+
+
+def test_auth_contract():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            # unauthenticated /v1 -> 401 OpenAI-style error
+            r = await gw.client.post("/v1/chat/completions", json={"model": "x"})
+            assert r.status == 401
+            body = await r.json()
+            assert body["error"]["type"] == "authentication_error"
+
+            # unauthenticated admin -> 401
+            r = await gw.client.get("/api/endpoints")
+            assert r.status == 401
+
+            # bad login
+            r = await gw.client.post("/api/auth/login", json={
+                "username": "admin", "password": "wrong"})
+            assert r.status == 401
+
+            # good login + me
+            headers = await gw.admin_headers()
+            r = await gw.client.get("/api/auth/me", headers=headers)
+            assert r.status == 200
+            assert (await r.json())["role"] == "admin"
+
+            # api key without inference permission is rejected on /v1
+            r = await gw.client.post(
+                "/api/api-keys",
+                json={"name": "limited", "permissions": ["metrics.read"]},
+                headers=headers,
+            )
+            limited = (await r.json())["api_key"]
+            r = await gw.client.post(
+                "/v1/chat/completions", json={"model": "x"},
+                headers={"Authorization": f"Bearer {limited}"},
+            )
+            assert r.status == 403
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_viewer_role_is_read_only():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/users", json={
+                "username": "viewer1", "password": "viewerpw1",
+                "role": "viewer"}, headers=headers)
+            assert r.status == 201
+            r = await gw.client.post("/api/auth/login", json={
+                "username": "viewer1", "password": "viewerpw1"})
+            vtoken = (await r.json())["token"]
+            vheaders = {"Authorization": f"Bearer {vtoken}"}
+
+            r = await gw.client.get("/api/endpoints", headers=vheaders)
+            assert r.status == 200
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": "http://127.0.0.1:1"}, headers=vheaders)
+            assert r.status == 403
+            # self-service is allowed
+            r = await gw.client.post(
+                "/api/api-keys", json={"name": "mine"}, headers=vheaders)
+            assert r.status == 201
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_chat_completion_proxy_non_stream():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="mock-model").start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["choices"][0]["message"]["content"].startswith("tok0")
+            assert body["usage"]["completion_tokens"] == 5
+
+            # unknown model -> 404
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "nope", "messages": []}, headers=headers)
+            assert r.status == 404
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_chat_completion_proxy_stream_passthrough_and_tps():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="mock-model").start()
+        try:
+            ep = gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "mock-model", "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            assert "tok0" in raw and raw.strip().endswith("data: [DONE]")
+            # stream_options.include_usage was injected toward upstream
+            assert mock.requests_seen[-1]["stream_options"]["include_usage"]
+
+            # TPS got recorded from the stream's usage chunk
+            from llmlb_tpu.gateway.types import TpsApiKind
+            await asyncio.sleep(0.05)
+            tps = gw.state.load_manager.get_tps(
+                ep.id, "mock-model", TpsApiKind.CHAT)
+            assert tps is not None and tps > 0
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_responses_and_embeddings_and_models():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="mock-model").start()
+        try:
+            from llmlb_tpu.gateway.types import Capability
+            gw.register_mock(
+                mock.url, ["mock-model"],
+                capabilities=[Capability.CHAT_COMPLETION],
+            )
+            gw.register_mock(
+                mock.url + "/", ["embed-model"], name="emb",
+                capabilities=[Capability.EMBEDDINGS],
+            ) if False else None
+            headers = await gw.inference_headers()
+
+            r = await gw.client.post("/v1/responses", json={
+                "model": "mock-model", "input": "hello"}, headers=headers)
+            assert r.status == 200
+
+            r = await gw.client.get("/v1/models", headers=headers)
+            models = (await r.json())["data"]
+            assert any(m["id"] == "mock-model" for m in models)
+
+            r = await gw.client.get("/v1/models/mock-model", headers=headers)
+            assert r.status == 200
+
+            # embeddings require the capability: mock-model doesn't have it
+            r = await gw.client.post("/v1/embeddings", json={
+                "model": "mock-model", "input": "x"}, headers=headers)
+            assert r.status == 404
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_upstream_error_normalized_to_502():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(fail_with=500).start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "mock-model", "messages": []}, headers=headers)
+            assert r.status == 502
+            body = await r.json()
+            assert body["error"]["type"] == "server_error"
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_unreachable_endpoint_502():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock("http://127.0.0.1:1", ["dead-model"])
+            headers = await gw.inference_headers()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "dead-model", "messages": []}, headers=headers)
+            assert r.status == 502
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_endpoint_admin_crud_and_audit():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": mock.url, "name": "mock1"}, headers=headers)
+            assert r.status == 201, await r.text()
+            created = await r.json()
+            assert created["endpoint_type"] == "openai_compatible"
+
+            r = await gw.client.get("/api/endpoints", headers=headers)
+            eps = (await r.json())["endpoints"]
+            assert len(eps) == 1
+
+            eid = created["id"]
+            r = await gw.client.post(
+                f"/api/endpoints/{eid}/test", headers=headers)
+            assert (await r.json())["ok"] is True
+
+            r = await gw.client.put(f"/api/endpoints/{eid}", json={
+                "name": "renamed"}, headers=headers)
+            assert (await r.json())["name"] == "renamed"
+
+            r = await gw.client.delete(f"/api/endpoints/{eid}", headers=headers)
+            assert r.status == 200
+
+            # audit captured all of that
+            gw.state.audit.flush()
+            r = await gw.client.get(
+                "/api/audit-log?path=/api/endpoints", headers=headers)
+            entries = (await r.json())["entries"]
+            assert len(entries) >= 4
+            r = await gw.client.post("/api/audit-log/verify", headers=headers)
+            assert (await r.json())["ok"] is True
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_dashboard_apis():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            iheaders = await gw.inference_headers()
+            for _ in range(3):
+                await gw.client.post("/v1/chat/completions", json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                }, headers=iheaders)
+            headers = await gw.admin_headers()
+
+            r = await gw.client.get("/api/dashboard/overview", headers=headers)
+            ov = await r.json()
+            assert ov["requests"]["today"] == 3
+            assert ov["endpoints"]["online"] == 1
+
+            r = await gw.client.get(
+                "/api/dashboard/request-history", headers=headers)
+            minutes = (await r.json())["minutes"]
+            assert sum(m["requests"] for m in minutes) == 3
+
+            r = await gw.client.get("/api/dashboard/requests", headers=headers)
+            records = (await r.json())["records"]
+            assert len(records) == 3
+            detail = await gw.client.get(
+                f"/api/dashboard/requests/{records[0]['id']}", headers=headers)
+            assert detail.status == 200
+
+            r = await gw.client.get(
+                "/api/dashboard/token-stats", headers=headers)
+            stats = await r.json()
+            assert stats["total"]["requests"] == 3
+            assert stats["by_model"][0]["model"] == "mock-model"
+
+            r = await gw.client.get("/api/dashboard/clients", headers=headers)
+            assert r.status == 200
+
+            r = await gw.client.get("/api/system", headers=headers)
+            assert (await r.json())["name"] == "llmlb_tpu"
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_dashboard_websocket_receives_events():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            token = await gw.admin_token()
+            ws = await gw.client.ws_connect(f"/ws/dashboard?token={token}")
+            gw.state.events.publish("MetricsUpdated", {"x": 1})
+            msg = await asyncio.wait_for(ws.receive(), timeout=5)
+            event = json.loads(msg.data)
+            assert event["type"] == "MetricsUpdated"
+            await ws.close()
+
+            # viewer is rejected
+            headers = await gw.admin_headers()
+            await gw.client.post("/api/users", json={
+                "username": "v2", "password": "viewerpw1", "role": "viewer",
+            }, headers=headers)
+            r = await gw.client.post("/api/auth/login", json={
+                "username": "v2", "password": "viewerpw1"})
+            vtoken = (await r.json())["token"]
+            try:
+                await gw.client.ws_connect(f"/ws/dashboard?token={vtoken}")
+                assert False, "viewer WS should be rejected"
+            except Exception:
+                pass
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_update_drain_gate():
+    """During drain /v1/* returns 503 + Retry-After (reference §3.4)."""
+    async def run():
+        from llmlb_tpu.gateway.update import UpdateManager
+
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.state.update_manager = UpdateManager(
+                gw.state.gate, gw.state.events, drain_timeout_s=1.0)
+            gw.register_mock(mock.url, ["mock-model"])
+            iheaders = await gw.inference_headers()
+            aheaders = await gw.admin_headers()
+
+            gw.state.gate.start_rejecting()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "mock-model", "messages": []}, headers=iheaders)
+            assert r.status == 503
+            assert r.headers["Retry-After"] == "30"
+            # admin surface still reachable during drain
+            r = await gw.client.get("/api/system", headers=aheaders)
+            assert r.status == 200
+            gw.state.gate.stop_rejecting()
+
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "x"}],
+            }, headers=iheaders)
+            assert r.status == 200
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_benchmarks_service():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/benchmarks/tps", json={
+                "model": "mock-model", "requests": 6, "concurrency": 3,
+            }, headers=headers)
+            assert r.status == 202
+            run_id = (await r.json())["run_id"]
+            for _ in range(100):
+                r = await gw.client.get(
+                    f"/api/benchmarks/tps/{run_id}", headers=headers)
+                data = await r.json()
+                if data["status"] == "completed":
+                    break
+                await asyncio.sleep(0.05)
+            assert data["status"] == "completed"
+            assert data["succeeded"] == 6
+            assert data["latency_ms"]["p50"] > 0
+            assert data["per_endpoint"]
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())

@@ -1,0 +1,138 @@
+"""Units: token accounting, model names, sync parsing, registry, db roundtrip."""
+
+import json
+
+import pytest
+
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.model_names import strip_quant_suffix, to_canonical, to_engine_name
+from llmlb_tpu.gateway.model_sync import detect_capabilities, parse_models_response
+from llmlb_tpu.gateway.registry import EndpointRegistry
+from llmlb_tpu.gateway.token_accounting import (
+    StreamingTokenAccumulator,
+    estimate_tokens,
+    extract_usage_from_response,
+)
+from llmlb_tpu.gateway.types import (
+    Capability,
+    Endpoint,
+    EndpointModel,
+    EndpointStatus,
+    EndpointType,
+)
+
+
+def sse(payload: dict) -> bytes:
+    return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+
+def test_accumulator_captures_reported_usage():
+    acc = StreamingTokenAccumulator()
+    acc.feed(sse({"choices": [{"delta": {"content": "hel"}}]}))
+    acc.feed(sse({"choices": [{"delta": {"content": "lo"}}]}))
+    acc.feed(sse({"choices": [], "usage": {"prompt_tokens": 7, "completion_tokens": 2}}))
+    acc.feed(b"data: [DONE]\n\n")
+    pt, ct, reported = acc.finalize("hello prompt")
+    assert (pt, ct, reported) == (7, 2, True)
+
+
+def test_accumulator_estimates_when_no_usage():
+    acc = StreamingTokenAccumulator()
+    acc.feed(sse({"choices": [{"delta": {"content": "hello world, this is content"}}]}))
+    pt, ct, reported = acc.finalize("some prompt text")
+    assert not reported
+    assert ct >= 1 and pt >= 1
+
+
+def test_accumulator_handles_split_chunks():
+    """SSE frames split mid-line across TCP reads must still parse."""
+    acc = StreamingTokenAccumulator()
+    frame = sse({"choices": [{"delta": {"content": "abc"}}],
+                 "usage": {"prompt_tokens": 3, "completion_tokens": 1}})
+    acc.feed(frame[:10])
+    acc.feed(frame[10:])
+    pt, ct, reported = acc.finalize()
+    assert (pt, ct, reported) == (3, 1, True)
+
+
+def test_extract_usage_variants():
+    assert extract_usage_from_response(
+        {"usage": {"prompt_tokens": 1, "completion_tokens": 2}}) == (1, 2)
+    assert extract_usage_from_response(
+        {"usage": {"input_tokens": 3, "output_tokens": 4}}) == (3, 4)
+    assert extract_usage_from_response({}) is None
+
+
+def test_estimate_tokens_nonzero():
+    assert estimate_tokens("hello world this is a test") > 3
+    assert estimate_tokens("") == 0
+
+
+def test_model_name_mapping():
+    assert to_canonical("llama3:8b") == "meta-llama/Meta-Llama-3-8B-Instruct"
+    assert to_canonical("qwen2.5:0.5b") == "Qwen/Qwen2.5-0.5B-Instruct"
+    assert to_canonical("unknown-model") == "unknown-model"
+    assert to_engine_name("meta-llama/Meta-Llama-3-8B-Instruct", "ollama") == "llama3:8b"
+    assert to_engine_name("meta-llama/Meta-Llama-3-8B-Instruct", "tpu") == "llama-3-8b"
+    assert to_engine_name("whatever", "ollama") == "whatever"
+    assert strip_quant_suffix("model-7b-Q4_K_M") == "model-7b"
+    assert strip_quant_suffix("model.fp16") == "model"
+
+
+def test_sync_parsing_both_shapes():
+    openai_shape = {"data": [{"id": "m1"}, {"id": "m2", "max_model_len": 8192}]}
+    assert [m["id"] for m in parse_models_response(openai_shape)] == ["m1", "m2"]
+    ollama_shape = {"models": [{"name": "llama3:8b"}, {"model": "qwen2.5:0.5b"}]}
+    assert [m["id"] for m in parse_models_response(ollama_shape)] == [
+        "llama3:8b", "qwen2.5:0.5b"]
+    assert parse_models_response({}) == []
+
+
+def test_capability_heuristics():
+    assert detect_capabilities("nomic-embed-text") == [Capability.EMBEDDINGS]
+    assert detect_capabilities("whisper-large-v3") == [Capability.AUDIO_TRANSCRIPTION]
+    assert detect_capabilities("sdxl") == [Capability.IMAGE_GENERATION]
+    assert detect_capabilities("llama3:8b") == [Capability.CHAT_COMPLETION]
+
+
+def test_registry_roundtrip_and_find(tmp_path):
+    db = Database(str(tmp_path / "t.db"))
+    reg = EndpointRegistry(db)
+    ep = Endpoint(name="tpu0", base_url="http://127.0.0.1:8100",
+                  endpoint_type=EndpointType.TPU)
+    reg.add(ep)
+    with pytest.raises(ValueError):
+        reg.add(Endpoint(name="dup", base_url="http://127.0.0.1:8100/"))
+
+    reg.update_status(ep.id, EndpointStatus.ONLINE, latency_ms=3.5)
+    reg.sync_models(ep.id, [
+        EndpointModel(endpoint_id=ep.id, model_id="llama-3-8b",
+                      canonical_name="meta-llama/Meta-Llama-3-8B-Instruct"),
+    ])
+    found = reg.find_by_model("meta-llama/Meta-Llama-3-8B-Instruct")
+    assert len(found) == 1 and found[0][0].id == ep.id
+    # engine-local name also resolves
+    assert len(reg.find_by_model("llama-3-8b")) == 1
+
+    # persistence: a fresh registry over the same DB sees everything
+    reg2 = EndpointRegistry(db)
+    assert reg2.get(ep.id).status == EndpointStatus.ONLINE
+    assert len(reg2.models_for(ep.id)) == 1
+
+    assert reg.remove(ep.id)
+    assert reg.find_by_model("llama-3-8b") == []
+
+
+def test_registry_capability_listing(tmp_path):
+    db = Database(str(tmp_path / "t.db"))
+    reg = EndpointRegistry(db)
+    ep = Endpoint(name="audio", base_url="http://127.0.0.1:9")
+    reg.add(ep)
+    reg.update_status(ep.id, EndpointStatus.ONLINE)
+    reg.sync_models(ep.id, [
+        EndpointModel(endpoint_id=ep.id, model_id="whisper-large-v3",
+                      canonical_name="openai/whisper-large-v3",
+                      capabilities=[Capability.AUDIO_TRANSCRIPTION]),
+    ])
+    assert len(reg.list_online_by_capability(Capability.AUDIO_TRANSCRIPTION)) == 1
+    assert reg.list_online_by_capability(Capability.IMAGE_GENERATION) == []

@@ -1,0 +1,207 @@
+"""Integration tests: health failover, detection, sync, TPS-driven balancing.
+
+Mirrors the reference integration tier (endpoint_health_check_test.rs,
+endpoint_auto_recovery_test.rs, endpoint_latency_routing_test.rs).
+"""
+
+import asyncio
+
+from llmlb_tpu.gateway.detection import Unreachable, detect_endpoint_type
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.model_sync import sync_endpoint_models
+from llmlb_tpu.gateway.types import EndpointStatus, EndpointType, TpsApiKind
+from tests.support import GatewayHarness, MockOllamaEndpoint, MockOpenAIEndpoint
+
+
+def _checker(gw, interval=3600.0) -> EndpointHealthChecker:
+    return EndpointHealthChecker(
+        gw.state.registry, gw.state.load_manager, gw.state.db,
+        gw.state.http, gw.state.events, interval_s=interval, timeout_s=2.0,
+    )
+
+
+def test_detection_priority():
+    async def run():
+        gw = await GatewayHarness.create()
+        openai_mock = await MockOpenAIEndpoint().start()
+        ollama_mock = await MockOllamaEndpoint().start()
+        try:
+            t = await detect_endpoint_type(openai_mock.url, gw.state.http)
+            assert t == EndpointType.OPENAI_COMPATIBLE
+            t = await detect_endpoint_type(ollama_mock.url, gw.state.http)
+            assert t == EndpointType.OLLAMA
+            try:
+                await detect_endpoint_type("http://127.0.0.1:1", gw.state.http)
+                assert False, "expected Unreachable"
+            except Unreachable:
+                pass
+        finally:
+            await openai_mock.stop()
+            await ollama_mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_health_two_strike_offline_and_recovery():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m1").start()
+        try:
+            ep = gw.register_mock(mock.url, ["m1"])
+            checker = _checker(gw)
+
+            # healthy check keeps it online + records latency
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert gw.state.registry.get(ep.id).status == EndpointStatus.ONLINE
+            assert gw.state.registry.get(ep.id).latency_ms is not None
+
+            # seed TPS, then kill the endpoint
+            gw.state.load_manager.update_tps(
+                ep.id, "m1", TpsApiKind.CHAT, 100, 1.0)
+            port = mock.server.port
+            await mock.stop()
+
+            # strike 1: still online
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert gw.state.registry.get(ep.id).status == EndpointStatus.ONLINE
+            # strike 2: offline + TPS cleared
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert gw.state.registry.get(ep.id).status == EndpointStatus.OFFLINE
+            assert gw.state.load_manager.get_tps(
+                ep.id, "m1", TpsApiKind.CHAT) is None
+
+            # offline endpoints are not selectable
+            assert gw.state.registry.find_by_model("m1") == []
+
+            # recovery on same port: online again + models resynced
+            mock2 = MockOpenAIEndpoint(model="m2")
+            from aiohttp.test_utils import TestServer as TS
+            from aiohttp import web
+            app = web.Application()
+            app.router.add_get("/v1/models", mock2._models)
+            mock2.server = TS(app, port=port)
+            await mock2.server.start_server()
+            try:
+                await checker.check_endpoint(gw.state.registry.get(ep.id))
+                ep_after = gw.state.registry.get(ep.id)
+                assert ep_after.status == EndpointStatus.ONLINE
+                models = [m.model_id for m in gw.state.registry.models_for(ep.id)]
+                assert models == ["m2"]
+            finally:
+                await mock2.server.close()
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_pending_endpoint_fails_fast():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            from llmlb_tpu.gateway.types import Endpoint
+            ep = Endpoint(name="dead", base_url="http://127.0.0.1:1")
+            gw.state.registry.add(ep)  # status PENDING
+            checker = _checker(gw)
+            await checker.check_endpoint(ep)
+            assert gw.state.registry.get(ep.id).status == EndpointStatus.OFFLINE
+            # health row persisted
+            rows = gw.state.db.list_health_checks(ep.id)
+            assert len(rows) == 1 and not rows[0]["ok"]
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_model_sync_ollama_shape():
+    async def run():
+        gw = await GatewayHarness.create()
+        ollama = await MockOllamaEndpoint(models=["llama3:8b", "nomic-embed-text"]).start()
+        try:
+            from llmlb_tpu.gateway.types import Capability, Endpoint
+            ep = Endpoint(name="ol", base_url=ollama.url,
+                          endpoint_type=EndpointType.OLLAMA)
+            gw.state.registry.add(ep)
+            added, removed = await sync_endpoint_models(
+                ep, gw.state.registry, gw.state.http)
+            assert (added, removed) == (2, 0)
+            models = gw.state.registry.models_for(ep.id)
+            by_id = {m.model_id: m for m in models}
+            # canonical mapping + capability heuristics applied
+            assert by_id["llama3:8b"].canonical_name == \
+                "meta-llama/Meta-Llama-3-8B-Instruct"
+            assert by_id["nomic-embed-text"].capabilities == [
+                Capability.EMBEDDINGS]
+        finally:
+            await ollama.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_tps_balancing_prefers_faster_endpoint():
+    """Two endpoints; the faster one (higher measured TPS) wins after probing."""
+    async def run():
+        gw = await GatewayHarness.create()
+        fast = await MockOpenAIEndpoint(tokens_per_reply=50).start()
+        slow = await MockOpenAIEndpoint(tokens_per_reply=50,
+                                        reply_delay_s=0.3).start()
+        try:
+            ep_fast = gw.register_mock(fast.url, ["m"], name="fast")
+            ep_slow = gw.register_mock(slow.url, ["m"], name="slow")
+            headers = await gw.inference_headers()
+
+            # probe phase: both get traffic (unmeasured → round-robin)
+            for _ in range(4):
+                r = await gw.client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                }, headers=headers)
+                assert r.status == 200
+
+            lm = gw.state.load_manager
+            tps_fast = lm.get_tps(ep_fast.id, "m", TpsApiKind.CHAT)
+            tps_slow = lm.get_tps(ep_slow.id, "m", TpsApiKind.CHAT)
+            assert tps_fast is not None and tps_slow is not None
+            assert tps_fast > tps_slow
+
+            # steady state: all traffic goes to the fast endpoint
+            seen_before = len(fast.requests_seen)
+            for _ in range(3):
+                await gw.client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                }, headers=headers)
+            assert len(fast.requests_seen) == seen_before + 3
+        finally:
+            await fast.stop()
+            await slow.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_endpoint_registration_via_api_with_detection_and_sync():
+    """POST /api/endpoints detects type, health-checks, and syncs models."""
+    async def run():
+        gw = await GatewayHarness.create()
+        # give the harness a real health checker for registration-time checks
+        gw.state.health_checker = _checker(gw)
+        mock = await MockOpenAIEndpoint(model="real-model").start()
+        try:
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": mock.url}, headers=headers)
+            assert r.status == 201
+            created = await r.json()
+            assert created["status"] == "online"
+            assert [m["model_id"] for m in created["models"]] == ["real-model"]
+
+            # immediately usable for inference
+            iheaders = await gw.inference_headers()
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "real-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=iheaders)
+            assert r.status == 200
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())

@@ -1,0 +1,106 @@
+"""The end-to-end slice (BASELINE.json config #2 shape): a real in-tree tpu://
+engine registered into the gateway, detected as TPU type, models synced, tokens
+streamed through /v1/chat/completions and /v1/responses with usage accounting
+and TPU telemetry flowing into the registry.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.engine.server import create_engine_app
+from llmlb_tpu.engine.service import Engine
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.types import EndpointStatus, EndpointType, TpsApiKind
+from tests.support import GatewayHarness
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = Engine.from_preset(
+        "debug-tiny", model_id="tpu-tiny", num_slots=4, slot_capacity=128,
+        prefill_buckets=(16, 32, 64),
+    )
+    yield eng
+    eng.shutdown()
+
+
+def test_tpu_engine_through_gateway(engine):
+    async def run():
+        gw = await GatewayHarness.create()
+        engine_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await engine_server.start_server()
+        engine_url = f"http://127.0.0.1:{engine_server.port}"
+        gw.state.health_checker = EndpointHealthChecker(
+            gw.state.registry, gw.state.load_manager, gw.state.db,
+            gw.state.http, gw.state.events, interval_s=3600, timeout_s=5.0,
+        )
+        try:
+            headers = await gw.admin_headers()
+            # register: the gateway must auto-detect the tpu endpoint type
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": engine_url, "name": "tpu0"}, headers=headers)
+            assert r.status == 201, await r.text()
+            created = await r.json()
+            assert created["endpoint_type"] == "tpu"
+            assert created["status"] == "online"
+            assert [m["model_id"] for m in created["models"]] == ["tpu-tiny"]
+
+            iheaders = await gw.inference_headers()
+
+            # non-stream chat through the gateway
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-tiny", "max_tokens": 5, "temperature": 0,
+                "messages": [{"role": "user", "content": "hello tpu"}],
+            }, headers=iheaders)
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["usage"]["completion_tokens"] >= 1
+
+            # streaming chat: SSE passes through, usage lands in TPS tracker
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-tiny", "max_tokens": 5, "temperature": 0,
+                "stream": True,
+                "messages": [{"role": "user", "content": "hello tpu"}],
+            }, headers=iheaders)
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            assert raw.strip().endswith("data: [DONE]")
+            usage_chunks = [
+                json.loads(l[6:]) for l in raw.splitlines()
+                if l.startswith("data: ") and l != "data: [DONE]"
+            ]
+            assert any(c.get("usage") for c in usage_chunks)
+
+            ep_id = created["id"]
+            await asyncio.sleep(0.05)
+            assert gw.state.load_manager.get_tps(
+                ep_id, "tpu-tiny", TpsApiKind.CHAT) is not None
+
+            # /v1/responses through the gateway (the north-star path)
+            r = await gw.client.post("/v1/responses", json={
+                "model": "tpu-tiny", "input": "ping", "max_output_tokens": 4,
+            }, headers=iheaders)
+            assert r.status == 200
+            resp_body = await r.json()
+            assert resp_body["status"] == "completed"
+            assert resp_body["usage"]["output_tokens"] >= 1
+
+            # health probe pulled TPU telemetry into the registry
+            ep = gw.state.registry.get(ep_id)
+            await gw.state.health_checker.check_endpoint(ep)
+            ep = gw.state.registry.get(ep_id)
+            assert ep.status == EndpointStatus.ONLINE
+            assert ep.accelerator.chip_count >= 1
+            assert ep.endpoint_type == EndpointType.TPU
+
+            # dashboard overview shows the chip count
+            r = await gw.client.get("/api/dashboard/overview", headers=headers)
+            ov = await r.json()
+            assert ov["tpu"]["total_chips"] >= 1
+        finally:
+            await engine_server.close()
+            await gw.close()
+    asyncio.run(run())

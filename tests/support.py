@@ -1,0 +1,253 @@
+"""Test support: in-process gateway + mock upstream endpoints.
+
+Port of the reference's test harness pattern (tests/support/lb.rs:16-110 test
+AppState builder, support/ollama.rs + node.rs mock endpoints, support/http.rs
+ephemeral-port spawner): register N mock endpoint URLs and exercise selection /
+health / failover / streaming entirely in-process, no TPUs required.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmlb_tpu.gateway.app import create_app
+from llmlb_tpu.gateway.app_state import build_app_state
+from llmlb_tpu.gateway.config import ServerConfig
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.registry import EndpointRegistry  # noqa: F401
+from llmlb_tpu.gateway.types import (
+    Capability,
+    Endpoint,
+    EndpointModel,
+    EndpointStatus,
+    EndpointType,
+)
+
+TEST_JWT_SECRET = "test-jwt-secret"
+ADMIN_PASSWORD = "adminpass1"
+
+
+class MockOpenAIEndpoint:
+    """A fake OpenAI-compatible runtime with configurable behavior."""
+
+    def __init__(self, *, model="mock-model", tokens_per_reply=5,
+                 reply_delay_s=0.0, fail_with: int | None = None,
+                 include_usage=True):
+        self.model = model
+        self.tokens_per_reply = tokens_per_reply
+        self.reply_delay_s = reply_delay_s
+        self.fail_with = fail_with
+        self.include_usage = include_usage
+        self.requests_seen: list[dict] = []
+        self.server: TestServer | None = None
+
+    @property
+    def url(self) -> str:
+        assert self.server is not None
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def start(self) -> "MockOpenAIEndpoint":
+        app = web.Application()
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._chat)
+        app.router.add_post("/v1/responses", self._chat)
+        app.router.add_post("/v1/embeddings", self._embeddings)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def stop(self) -> None:
+        if self.server:
+            await self.server.close()
+
+    async def _models(self, request):
+        return web.json_response(
+            {"object": "list", "data": [{"id": self.model, "object": "model"}]}
+        )
+
+    async def _chat(self, request):
+        body = await request.json()
+        self.requests_seen.append(body)
+        if self.fail_with:
+            return web.json_response({"error": "induced"}, status=self.fail_with)
+        if self.reply_delay_s:
+            await asyncio.sleep(self.reply_delay_s)
+        n = self.tokens_per_reply
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for i in range(n):
+                chunk = {
+                    "id": "chatcmpl-mock", "object": "chat.completion.chunk",
+                    "model": body.get("model"),
+                    "choices": [{"index": 0, "delta": {"content": f"tok{i} "},
+                                 "finish_reason": None}],
+                }
+                await resp.write(
+                    b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                )
+            final = {
+                "id": "chatcmpl-mock", "object": "chat.completion.chunk",
+                "model": body.get("model"),
+                "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+            }
+            await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
+            if self.include_usage:
+                usage_chunk = {
+                    "id": "chatcmpl-mock", "object": "chat.completion.chunk",
+                    "choices": [],
+                    "usage": {"prompt_tokens": 7, "completion_tokens": n,
+                              "total_tokens": 7 + n},
+                }
+                await resp.write(
+                    b"data: " + json.dumps(usage_chunk).encode() + b"\n\n"
+                )
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        payload = {
+            "id": "chatcmpl-mock", "object": "chat.completion",
+            "model": body.get("model"),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": " ".join(f"tok{i}" for i in range(n))},
+                "finish_reason": "stop",
+            }],
+        }
+        if self.include_usage:
+            payload["usage"] = {
+                "prompt_tokens": 7, "completion_tokens": n, "total_tokens": 7 + n,
+            }
+        return web.json_response(payload)
+
+    async def _embeddings(self, request):
+        body = await request.json()
+        self.requests_seen.append(body)
+        return web.json_response({
+            "object": "list",
+            "data": [{"object": "embedding", "index": 0,
+                      "embedding": [0.1, 0.2, 0.3]}],
+            "model": body.get("model"),
+            "usage": {"prompt_tokens": 4, "total_tokens": 4},
+        })
+
+
+class MockOllamaEndpoint:
+    """Speaks Ollama's discovery surface (/api/tags) for detection/sync tests."""
+
+    def __init__(self, models=("llama3:8b",)):
+        self.models = list(models)
+        self.server: TestServer | None = None
+
+    @property
+    def url(self) -> str:
+        assert self.server is not None
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def start(self) -> "MockOllamaEndpoint":
+        app = web.Application()
+        app.router.add_get("/api/tags", self._tags)
+        app.router.add_get("/v1/models", self._models)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def stop(self) -> None:
+        if self.server:
+            await self.server.close()
+
+    async def _tags(self, request):
+        return web.json_response(
+            {"models": [{"name": m} for m in self.models]}
+        )
+
+    async def _models(self, request):
+        return web.json_response(
+            {"object": "list", "data": [{"id": m} for m in self.models]}
+        )
+
+
+class GatewayHarness:
+    """In-process gateway with real middlewares over an in-memory DB."""
+
+    def __init__(self, state, client: TestClient):
+        self.state = state
+        self.client = client
+        self._admin_token: str | None = None
+        self._api_key: str | None = None
+
+    @classmethod
+    async def create(cls, *, start_background=False) -> "GatewayHarness":
+        import os
+
+        os.environ["LLMLB_ADMIN_PASSWORD"] = ADMIN_PASSWORD
+        os.environ["LLMLB_JWT_SECRET"] = TEST_JWT_SECRET
+        config = ServerConfig.from_env()
+        state = await build_app_state(
+            config, db=Database(":memory:"), start_background=start_background
+        )
+        app = create_app(state)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return cls(state, client)
+
+    async def close(self) -> None:
+        await self.client.close()
+
+    # ------------------------------------------------------------ auth helpers
+
+    async def admin_token(self) -> str:
+        if self._admin_token is None:
+            resp = await self.client.post("/api/auth/login", json={
+                "username": "admin", "password": ADMIN_PASSWORD,
+            })
+            assert resp.status == 200, await resp.text()
+            self._admin_token = (await resp.json())["token"]
+        return self._admin_token
+
+    async def admin_headers(self) -> dict:
+        return {"Authorization": f"Bearer {await self.admin_token()}"}
+
+    async def inference_key(self) -> str:
+        if self._api_key is None:
+            resp = await self.client.post(
+                "/api/api-keys",
+                json={"name": "test", "permissions": [
+                    "openai.inference", "openai.models.read"]},
+                headers=await self.admin_headers(),
+            )
+            assert resp.status == 201, await resp.text()
+            self._api_key = (await resp.json())["api_key"]
+        return self._api_key
+
+    async def inference_headers(self) -> dict:
+        return {"Authorization": f"Bearer {await self.inference_key()}"}
+
+    # -------------------------------------------------------------- endpoints
+
+    def register_mock(
+        self, url: str, models: list[str],
+        endpoint_type=EndpointType.OPENAI_COMPATIBLE,
+        capabilities=None, name=None,
+    ) -> Endpoint:
+        """Register an endpoint directly in the registry, already ONLINE."""
+        ep = Endpoint(
+            name=name or url, base_url=url, endpoint_type=endpoint_type,
+            status=EndpointStatus.ONLINE,
+        )
+        self.state.registry.add(ep)
+        self.state.registry.sync_models(ep.id, [
+            EndpointModel(
+                endpoint_id=ep.id, model_id=m, canonical_name=m,
+                capabilities=capabilities or [Capability.CHAT_COMPLETION],
+            )
+            for m in models
+        ])
+        return ep
