@@ -475,6 +475,9 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    from llmlb_tpu.native import ensure_native_built
+
+    ensure_native_built()  # build before serving; loader itself never builds
     if args.checkpoint:
         engine = Engine.from_checkpoint(
             args.checkpoint, model_id=args.model_id,

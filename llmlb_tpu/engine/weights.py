@@ -54,10 +54,21 @@ def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
     return params
 
 
+def _open_shard(path: str):
+    """Prefer the C++ mmap reader (native/safetensors_reader.cpp); fall back
+    to the safetensors package. Both expose keys()/get_tensor()."""
+    try:
+        from llmlb_tpu.native import NativeSafetensors
+
+        return NativeSafetensors(path)
+    except Exception:
+        from safetensors import safe_open
+
+        return safe_open(path, framework="numpy")
+
+
 def _safetensors_getter(model_dir: str) -> TensorGetter:
     """Build a name→tensor getter over all *.safetensors shards in a directory."""
-    from safetensors import safe_open
-
     index_path = os.path.join(model_dir, "model.safetensors.index.json")
     name_to_file: dict[str, str] = {}
     if os.path.exists(index_path):
@@ -66,17 +77,15 @@ def _safetensors_getter(model_dir: str) -> TensorGetter:
     else:
         for fname in sorted(os.listdir(model_dir)):
             if fname.endswith(".safetensors"):
-                with safe_open(os.path.join(model_dir, fname), framework="numpy") as sf:
-                    for name in sf.keys():
-                        name_to_file[name] = fname
+                shard = _open_shard(os.path.join(model_dir, fname))
+                for name in shard.keys():
+                    name_to_file[name] = fname
     handles: dict[str, object] = {}
 
     def get(name: str) -> np.ndarray:
         fname = name_to_file[name]
         if fname not in handles:
-            handles[fname] = safe_open(
-                os.path.join(model_dir, fname), framework="numpy"
-            )
+            handles[fname] = _open_shard(os.path.join(model_dir, fname))
         return handles[fname].get_tensor(name)
 
     return get

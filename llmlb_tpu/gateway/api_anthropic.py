@@ -188,20 +188,26 @@ def openai_response_to_anthropic(resp: dict, model: str) -> dict:
 class AnthropicStreamEncoder:
     """Re-encodes OpenAI chat chunks as Anthropic SSE events (anthropic.rs:728).
 
-    Stateful: tracks the open content block (text vs tool_use) and emits
-    block start/stop transitions, then message_delta with stop_reason/usage
-    and message_stop at the end.
+    Stateful: text deltas stream through an open text block; tool-call deltas
+    are buffered per OpenAI tool index (OpenAI may interleave fragments of
+    parallel tool calls, which cannot map onto Anthropic's sequential block
+    stream) and emitted as complete tool_use blocks at finish. message_start
+    carries an input-token estimate (the upstream only reports usage at stream
+    end); message_delta carries the reported figures.
     """
 
-    def __init__(self, model: str):
+    def __init__(self, model: str, input_token_estimate: int = 0):
         self.model = model
         self.message_id = f"msg_{uuid.uuid4().hex[:24]}"
         self.started = False
         self.block_index = -1
-        self.block_type: str | None = None  # "text" | "tool_use"
+        self.block_type: str | None = None  # "text" (tool_use emitted at end)
         self.finish_reason: str | None = None
+        self.input_token_estimate = input_token_estimate
         self.usage = {"input_tokens": 0, "output_tokens": 0}
-        self._tool_ids: dict[int, str] = {}
+        self._usage_reported = False
+        # OpenAI tool index -> {"id", "name", "args": [fragments]}
+        self._tools: dict[int, dict] = {}
 
     @staticmethod
     def _event(name: str, payload: dict) -> bytes:
@@ -218,7 +224,8 @@ class AnthropicStreamEncoder:
                 "id": self.message_id, "type": "message", "role": "assistant",
                 "model": self.model, "content": [],
                 "stop_reason": None, "stop_sequence": None,
-                "usage": {"input_tokens": 0, "output_tokens": 0},
+                "usage": {"input_tokens": self.input_token_estimate,
+                          "output_tokens": 0},
             },
         })
 
@@ -252,6 +259,7 @@ class AnthropicStreamEncoder:
                 "input_tokens": usage.get("prompt_tokens", 0),
                 "output_tokens": usage.get("completion_tokens", 0),
             }
+            self._usage_reported = True
         for choice in chunk.get("choices") or []:
             if not isinstance(choice, dict):
                 continue
@@ -271,23 +279,42 @@ class AnthropicStreamEncoder:
             for tc in delta.get("tool_calls") or []:
                 idx = tc.get("index", 0)
                 fn = tc.get("function") or {}
-                if tc.get("id") or fn.get("name"):
-                    tool_id = tc.get("id") or f"toolu_{uuid.uuid4().hex[:12]}"
-                    self._tool_ids[idx] = tool_id
-                    out.extend(self._open_block("tool_use", {
-                        "type": "tool_use", "id": tool_id,
-                        "name": fn.get("name", ""), "input": {},
-                    }))
+                tool = self._tools.setdefault(
+                    idx, {"id": None, "name": "", "args": []}
+                )
+                if tc.get("id"):
+                    tool["id"] = tc["id"]
+                if fn.get("name"):
+                    tool["name"] = fn["name"]
                 if fn.get("arguments"):
-                    out.append(self._event("content_block_delta", {
-                        "type": "content_block_delta", "index": self.block_index,
-                        "delta": {"type": "input_json_delta",
-                                  "partial_json": fn["arguments"]},
-                    }))
+                    tool["args"].append(fn["arguments"])
         return out
 
     def finish(self) -> list[bytes]:
         out = self._close_block()
+        for idx in sorted(self._tools):
+            tool = self._tools[idx]
+            self.block_index += 1
+            out.append(self._event("content_block_start", {
+                "type": "content_block_start", "index": self.block_index,
+                "content_block": {
+                    "type": "tool_use",
+                    "id": tool["id"] or f"toolu_{uuid.uuid4().hex[:12]}",
+                    "name": tool["name"], "input": {},
+                },
+            }))
+            args = "".join(tool["args"])
+            if args:
+                out.append(self._event("content_block_delta", {
+                    "type": "content_block_delta", "index": self.block_index,
+                    "delta": {"type": "input_json_delta", "partial_json": args},
+                }))
+            out.append(self._event("content_block_stop", {
+                "type": "content_block_stop", "index": self.block_index,
+            }))
+        usage = {"output_tokens": self.usage["output_tokens"]}
+        if self._usage_reported:
+            usage["input_tokens"] = self.usage["input_tokens"]
         out.append(self._event("message_delta", {
             "type": "message_delta",
             "delta": {
@@ -296,7 +323,7 @@ class AnthropicStreamEncoder:
                 ),
                 "stop_sequence": None,
             },
-            "usage": {"output_tokens": self.usage["output_tokens"]},
+            "usage": usage,
         }))
         out.append(self._event("message_stop", {"type": "message_stop"}))
         return out
@@ -403,7 +430,14 @@ async def _stream_transform(
     )
     await resp.prepare(request)
     lease.complete()
-    encoder = AnthropicStreamEncoder(original_body.get("model", model))
+    prompt_text = "\n".join(
+        m.get("content") for m in original_body.get("messages", [])
+        if isinstance(m, dict) and isinstance(m.get("content"), str)
+    )
+    encoder = AnthropicStreamEncoder(
+        original_body.get("model", model),
+        input_token_estimate=estimate_tokens(prompt_text),
+    )
     buffer = b""
     try:
         async for raw_chunk in upstream.content.iter_any():

@@ -49,10 +49,19 @@ class AuditEntry:
 
 
 def batch_hash(prev_hash: str, entries: list[AuditEntry]) -> str:
+    canon = [e.canonical().encode() for e in entries]
+    try:
+        from llmlb_tpu.native import native_chain_hash
+
+        digest = native_chain_hash(prev_hash, canon)
+        if digest is not None:
+            return digest
+    except Exception:  # native lib unavailable/broken: identical Python path
+        pass
     h = hashlib.sha256()
     h.update(prev_hash.encode())
-    for e in entries:
-        h.update(e.canonical().encode())
+    for c in canon:
+        h.update(c)
     return h.hexdigest()
 
 

@@ -29,6 +29,10 @@ async def run_server(config: ServerConfig | None = None) -> None:
     config = config or ServerConfig.from_env()
     os.makedirs(os.path.dirname(config.database_url) or ".", exist_ok=True)
 
+    from llmlb_tpu.native import ensure_native_built
+
+    ensure_native_built()  # blocking make belongs here, not in a request path
+
     lock = ServerLock.acquire(config.port)
     state = await build_app_state(config)
     state.update_manager = UpdateManager(

@@ -1,0 +1,225 @@
+"""ctypes bindings to the C++ native components (native/libllmlb_native.so).
+
+The library is built with `make -C native` (done automatically on first use
+when a toolchain is present). Every consumer has a pure-Python fallback, so
+the framework runs without the native build — but weight loading and SSE
+accounting use the native paths when available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("llmlb_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libllmlb_native.so")
+
+_lib: ctypes.CDLL | None = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.st_open.restype = c.c_void_p
+    lib.st_open.argtypes = [c.c_char_p]
+    lib.st_error.restype = c.c_char_p
+    lib.st_error.argtypes = [c.c_void_p]
+    lib.st_num_tensors.restype = c.c_int64
+    lib.st_num_tensors.argtypes = [c.c_void_p]
+    lib.st_tensor_name.restype = c.c_char_p
+    lib.st_tensor_name.argtypes = [c.c_void_p, c.c_int64]
+    lib.st_tensor_dtype.restype = c.c_char_p
+    lib.st_tensor_dtype.argtypes = [c.c_void_p, c.c_int64]
+    lib.st_tensor_ndim.restype = c.c_int64
+    lib.st_tensor_ndim.argtypes = [c.c_void_p, c.c_int64]
+    lib.st_tensor_shape.restype = None
+    lib.st_tensor_shape.argtypes = [c.c_void_p, c.c_int64, c.POINTER(c.c_int64)]
+    lib.st_tensor_data.restype = c.c_void_p
+    lib.st_tensor_data.argtypes = [c.c_void_p, c.c_int64, c.POINTER(c.c_int64)]
+    lib.st_close.restype = None
+    lib.st_close.argtypes = [c.c_void_p]
+
+    lib.sha256_hex.restype = None
+    lib.sha256_hex.argtypes = [c.c_char_p, c.c_int64, c.c_char_p]
+    lib.chain_hash_hex.restype = None
+    lib.chain_hash_hex.argtypes = [
+        c.c_char_p, c.POINTER(c.c_char_p), c.POINTER(c.c_int64), c.c_int64,
+        c.c_char_p,
+    ]
+
+    lib.sse_new.restype = c.c_void_p
+    lib.sse_feed.restype = None
+    lib.sse_feed.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.sse_frames.restype = c.c_int64
+    lib.sse_frames.argtypes = [c.c_void_p]
+    lib.sse_usage.restype = c.c_int32
+    lib.sse_usage.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.POINTER(c.c_int64)
+    ]
+    lib.sse_free.restype = None
+    lib.sse_free.argtypes = [c.c_void_p]
+
+
+def ensure_native_built() -> bool:
+    """Build the library if missing. BLOCKING (runs make): call this from
+    process startup (server mains, test setup), never from a request path."""
+    global _build_attempted
+    with _lib_lock:
+        if os.path.exists(_LIB_PATH):
+            return True
+        if _build_attempted:
+            return False
+        _build_attempted = True
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception as e:
+            log.info("native build unavailable (%s); using Python fallbacks", e)
+            return False
+    return os.path.exists(_LIB_PATH)
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Load the already-built native library; None if unavailable. Does NOT
+    build — ensure_native_built() does that at process startup."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _configure(lib)
+            _lib = lib
+        except OSError as e:
+            log.warning("failed to load native library: %s", e)
+            return None
+        return _lib
+
+
+# ---------------------------------------------------------------- safetensors
+
+_ST_DTYPES = {
+    "F64": "float64", "F32": "float32", "F16": "float16", "BF16": "bfloat16",
+    "I64": "int64", "I32": "int32", "I16": "int16", "I8": "int8",
+    "U8": "uint8", "BOOL": "bool",
+}
+
+
+class NativeSafetensors:
+    """Zero-copy reader over one .safetensors file via the C++ mmap reader."""
+
+    def __init__(self, path: str):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.st_open(path.encode())
+        err = lib.st_error(self._handle)
+        if err:
+            message = err.decode()
+            lib.st_close(self._handle)
+            self._handle = None
+            raise ValueError(f"safetensors open failed: {message}")
+        self._index: dict[str, int] = {}
+        for i in range(lib.st_num_tensors(self._handle)):
+            self._index[lib.st_tensor_name(self._handle, i).decode()] = i
+
+    def keys(self):
+        return list(self._index)
+
+    def get_tensor(self, name: str):
+        """Owned array (safe after close). The mmap view is copied exactly
+        once here; async device transfers (jax.device_put retains the numpy
+        array, not this reader) must never alias the mapping, which is
+        unmapped when the reader is dropped."""
+        import numpy as np
+
+        return np.array(self._view(name))
+
+    def _view(self, name: str):
+        import ml_dtypes  # ships with jax; provides numpy bfloat16
+        import numpy as np
+
+        i = self._index[name]
+        lib = self._lib
+        dtype_tag = lib.st_tensor_dtype(self._handle, i).decode()
+        ndim = lib.st_tensor_ndim(self._handle, i)
+        shape = (ctypes.c_int64 * max(ndim, 1))()
+        lib.st_tensor_shape(self._handle, i, shape)
+        nbytes = ctypes.c_int64()
+        ptr = lib.st_tensor_data(self._handle, i, ctypes.byref(nbytes))
+        buf = (ctypes.c_char * nbytes.value).from_address(ptr)
+        dtype_name = _ST_DTYPES.get(dtype_tag)
+        if dtype_name is None:
+            raise ValueError(f"unsupported safetensors dtype {dtype_tag}")
+        np_dtype = (
+            ml_dtypes.bfloat16 if dtype_name == "bfloat16"
+            else np.dtype(dtype_name)
+        )
+        arr = np.frombuffer(buf, dtype=np_dtype)
+        return arr.reshape(tuple(shape[d] for d in range(ndim)))
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.st_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()
+
+
+# ----------------------------------------------------------------- hash chain
+
+
+def native_chain_hash(prev_hash_hex: str, entries: list[bytes]) -> str | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    n = len(entries)
+    arr = (ctypes.c_char_p * n)(*entries)
+    lens = (ctypes.c_int64 * n)(*[len(e) for e in entries])
+    out = ctypes.create_string_buffer(65)
+    lib.chain_hash_hex(prev_hash_hex.encode(), arr, lens, n, out)
+    return out.value.decode()
+
+
+# ------------------------------------------------------------------ SSE scan
+
+
+class NativeSseScanner:
+    def __init__(self):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.sse_new()
+
+    def feed(self, chunk: bytes) -> None:
+        self._lib.sse_feed(self._handle, chunk, len(chunk))
+
+    @property
+    def frames(self) -> int:
+        return self._lib.sse_frames(self._handle)
+
+    def usage(self) -> tuple[int, int] | None:
+        pt = ctypes.c_int64()
+        ct = ctypes.c_int64()
+        if self._lib.sse_usage(self._handle, ctypes.byref(pt), ctypes.byref(ct)):
+            return int(pt.value), int(ct.value)
+        return None
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.sse_free(self._handle)
+            self._handle = None

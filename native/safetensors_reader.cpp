@@ -1,0 +1,311 @@
+// safetensors_reader: mmap-based zero-copy safetensors file reader.
+//
+// TPU-native equivalent of the reference's poc/nemotron-safetensors-cpp probe
+// (SURVEY.md §2.3 item 2), built as a reusable shared library feeding the
+// engine's weight ingestion: the Python side gets (name, dtype, shape, data
+// pointer) per tensor and wraps the mapped region in numpy arrays without
+// copying, so multi-GB checkpoints stream host->HBM without a host-side copy.
+//
+// File format (public spec, huggingface/safetensors): 8-byte little-endian
+// header length N, then N bytes of JSON: {"name": {"dtype": "F32",
+// "shape": [..], "data_offsets": [begin, end]}, ...} with optional
+// "__metadata__", then the tensor byte buffer.
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct TensorInfo {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  uint64_t begin = 0;
+  uint64_t end = 0;
+};
+
+struct File {
+  int fd = -1;
+  uint8_t *map = nullptr;
+  size_t size = 0;
+  size_t data_start = 0;
+  std::vector<TensorInfo> tensors;
+  std::string error;
+};
+
+// --- minimal JSON parser for the safetensors header subset -----------------
+
+struct Parser {
+  const char *p;
+  const char *end;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool expect(char c) {
+    skip_ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+  bool parse_string(std::string &out) {
+    skip_ws();
+    if (p >= end || *p != '"')
+      return false;
+    ++p;
+    out.clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+        case 'n': out += '\n'; break;
+        case 't': out += '\t'; break;
+        case 'r': out += '\r'; break;
+        case '\\': out += '\\'; break;
+        case '"': out += '"'; break;
+        case '/': out += '/'; break;
+        default: out += *p; break; // \uXXXX not needed for tensor names
+        }
+      } else {
+        out += *p;
+      }
+      ++p;
+    }
+    if (p >= end)
+      return false;
+    ++p; // closing quote
+    return true;
+  }
+  bool parse_uint(uint64_t &out) {
+    skip_ws();
+    if (p >= end || *p < '0' || *p > '9')
+      return false;
+    out = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      out = out * 10 + uint64_t(*p - '0');
+      ++p;
+    }
+    return true;
+  }
+  // skip any JSON value (for __metadata__ contents)
+  bool skip_value() {
+    skip_ws();
+    if (p >= end)
+      return false;
+    if (*p == '"') {
+      std::string s;
+      return parse_string(s);
+    }
+    if (*p == '{' || *p == '[') {
+      char open = *p, close = (*p == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      while (p < end) {
+        char c = *p;
+        if (in_str) {
+          if (c == '\\')
+            ++p;
+          else if (c == '"')
+            in_str = false;
+        } else if (c == '"') {
+          in_str = true;
+        } else if (c == open) {
+          ++depth;
+        } else if (c == close) {
+          --depth;
+          if (depth == 0) {
+            ++p;
+            return true;
+          }
+        }
+        ++p;
+      }
+      return false;
+    }
+    // number / literal
+    while (p < end && *p != ',' && *p != '}' && *p != ']')
+      ++p;
+    return true;
+  }
+};
+
+bool parse_header(File *f, const char *json, size_t len) {
+  Parser ps{json, json + len};
+  if (!ps.expect('{'))
+    return false;
+  ps.skip_ws();
+  if (ps.p < ps.end && *ps.p == '}')
+    return true; // empty header
+  while (true) {
+    std::string key;
+    if (!ps.parse_string(key))
+      return false;
+    if (!ps.expect(':'))
+      return false;
+    if (key == "__metadata__") {
+      if (!ps.skip_value())
+        return false;
+    } else {
+      TensorInfo t;
+      t.name = key;
+      if (!ps.expect('{'))
+        return false;
+      while (true) {
+        std::string field;
+        if (!ps.parse_string(field))
+          return false;
+        if (!ps.expect(':'))
+          return false;
+        if (field == "dtype") {
+          if (!ps.parse_string(t.dtype))
+            return false;
+        } else if (field == "shape") {
+          if (!ps.expect('['))
+            return false;
+          ps.skip_ws();
+          if (ps.p < ps.end && *ps.p == ']') {
+            ++ps.p;
+          } else {
+            while (true) {
+              uint64_t d;
+              if (!ps.parse_uint(d))
+                return false;
+              t.shape.push_back(int64_t(d));
+              if (ps.expect(']'))
+                break;
+              if (!ps.expect(','))
+                return false;
+            }
+          }
+        } else if (field == "data_offsets") {
+          if (!ps.expect('['))
+            return false;
+          if (!ps.parse_uint(t.begin))
+            return false;
+          if (!ps.expect(','))
+            return false;
+          if (!ps.parse_uint(t.end))
+            return false;
+          if (!ps.expect(']'))
+            return false;
+        } else {
+          if (!ps.skip_value())
+            return false;
+        }
+        if (ps.expect('}'))
+          break;
+        if (!ps.expect(','))
+          return false;
+      }
+      f->tensors.push_back(std::move(t));
+    }
+    if (ps.expect('}'))
+      return true;
+    if (!ps.expect(','))
+      return false;
+  }
+}
+
+} // namespace
+
+extern "C" {
+
+void *st_open(const char *path) {
+  File *f = new File();
+  f->fd = open(path, O_RDONLY);
+  if (f->fd < 0) {
+    f->error = "cannot open file";
+    return f;
+  }
+  struct stat st;
+  if (fstat(f->fd, &st) != 0 || st.st_size < 8) {
+    f->error = "stat failed or file too small";
+    return f;
+  }
+  f->size = size_t(st.st_size);
+  f->map = static_cast<uint8_t *>(
+      mmap(nullptr, f->size, PROT_READ, MAP_PRIVATE, f->fd, 0));
+  if (f->map == MAP_FAILED) {
+    f->map = nullptr;
+    f->error = "mmap failed";
+    return f;
+  }
+  uint64_t header_len = 0;
+  std::memcpy(&header_len, f->map, 8); // little-endian hosts only (x86/arm)
+  if (header_len > f->size - 8) {     // written to avoid uint64 wraparound
+    f->error = "header length exceeds file size";
+    return f;
+  }
+  f->data_start = 8 + size_t(header_len);
+  if (!parse_header(f, reinterpret_cast<const char *>(f->map + 8),
+                    size_t(header_len))) {
+    f->tensors.clear();
+    f->error = "header JSON parse failed";
+    return f;
+  }
+  // validate offsets against the data region
+  size_t data_len = f->size - f->data_start;
+  for (const auto &t : f->tensors) {
+    if (t.end < t.begin || t.end > data_len) {
+      f->tensors.clear();
+      f->error = "tensor data_offsets out of range: " + t.name;
+      return f;
+    }
+  }
+  return f;
+}
+
+const char *st_error(void *handle) {
+  File *f = static_cast<File *>(handle);
+  return f->error.empty() ? nullptr : f->error.c_str();
+}
+
+int64_t st_num_tensors(void *handle) {
+  return int64_t(static_cast<File *>(handle)->tensors.size());
+}
+
+const char *st_tensor_name(void *handle, int64_t i) {
+  return static_cast<File *>(handle)->tensors[size_t(i)].name.c_str();
+}
+
+const char *st_tensor_dtype(void *handle, int64_t i) {
+  return static_cast<File *>(handle)->tensors[size_t(i)].dtype.c_str();
+}
+
+int64_t st_tensor_ndim(void *handle, int64_t i) {
+  return int64_t(static_cast<File *>(handle)->tensors[size_t(i)].shape.size());
+}
+
+void st_tensor_shape(void *handle, int64_t i, int64_t *out) {
+  const auto &shape = static_cast<File *>(handle)->tensors[size_t(i)].shape;
+  for (size_t d = 0; d < shape.size(); ++d)
+    out[d] = shape[d];
+}
+
+// Returns the pointer into the mapping; nbytes via out param.
+const uint8_t *st_tensor_data(void *handle, int64_t i, int64_t *nbytes) {
+  File *f = static_cast<File *>(handle);
+  const TensorInfo &t = f->tensors[size_t(i)];
+  *nbytes = int64_t(t.end - t.begin);
+  return f->map + f->data_start + t.begin;
+}
+
+void st_close(void *handle) {
+  File *f = static_cast<File *>(handle);
+  if (f->map)
+    munmap(f->map, f->size);
+  if (f->fd >= 0)
+    close(f->fd);
+  delete f;
+}
+
+} // extern "C"

@@ -38,6 +38,16 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+def pytest_configure(config):
+    """Build the native library once up front so tests exercise native paths."""
+    try:
+        from llmlb_tpu.native import ensure_native_built
+
+        ensure_native_built()
+    except Exception:
+        pass
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
     import jax

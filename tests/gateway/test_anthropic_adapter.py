@@ -70,41 +70,89 @@ def test_response_conversion_with_tool_calls():
     assert out["usage"] == {"input_tokens": 10, "output_tokens": 4}
 
 
+def _event_names(bs):
+    return [
+        line.split(": ", 1)[1]
+        for b in bs
+        for line in b.decode().splitlines()
+        if line.startswith("event: ")
+    ]
+
+
+def _payloads(bs):
+    return [
+        json.loads(line.split(": ", 1)[1])
+        for b in bs
+        for line in b.decode().splitlines()
+        if line.startswith("data: ")
+    ]
+
+
 def test_stream_encoder_event_sequence():
-    enc = AnthropicStreamEncoder("m")
-    events = []
+    enc = AnthropicStreamEncoder("m", input_token_estimate=9)
+    events, payloads = [], []
 
-    def names(bs):
-        return [
-            line.split(": ", 1)[1]
-            for b in bs
-            for line in b.decode().splitlines()
-            if line.startswith("event: ")
-        ]
+    def push(bs):
+        events.extend(_event_names(bs))
+        payloads.extend(_payloads(bs))
 
-    events += names(enc.feed({
+    push(enc.feed({
         "choices": [{"delta": {"role": "assistant", "content": "he"}}]}))
-    events += names(enc.feed({"choices": [{"delta": {"content": "y"}}]}))
-    events += names(enc.feed({
+    push(enc.feed({"choices": [{"delta": {"content": "y"}}]}))
+    push(enc.feed({
         "choices": [{"delta": {"tool_calls": [{
             "index": 0, "id": "c1",
             "function": {"name": "f", "arguments": ""}}]}}]}))
-    events += names(enc.feed({
+    push(enc.feed({
         "choices": [{"delta": {"tool_calls": [{
             "index": 0, "function": {"arguments": '{"x":1}'}}]},
             "finish_reason": "tool_calls"}]}))
-    events += names(enc.feed({
+    push(enc.feed({
         "choices": [], "usage": {"prompt_tokens": 5, "completion_tokens": 3}}))
-    events += names(enc.finish())
+    push(enc.finish())
 
     assert events[0] == "message_start"
+    assert payloads[0]["message"]["usage"]["input_tokens"] == 9  # estimate
     assert "content_block_start" in events
     assert "content_block_delta" in events
-    # text block closes before tool_use block opens
+    # text block closes before the tool_use block opens
     first_stop = events.index("content_block_stop")
     second_start = events.index("content_block_start", first_stop)
     assert second_start > first_stop
     assert events[-2:] == ["message_delta", "message_stop"]
+    md = [p for p in payloads if p.get("type") == "message_delta"][0]
+    assert md["usage"] == {"output_tokens": 3, "input_tokens": 5}  # reported
+    tool_start = [p for p in payloads
+                  if p.get("type") == "content_block_start"
+                  and p["content_block"]["type"] == "tool_use"][0]
+    assert tool_start["content_block"]["name"] == "f"
+
+
+def test_stream_encoder_interleaved_parallel_tool_calls():
+    """Fragments of two tools interleaved by index must not splice JSON."""
+    enc = AnthropicStreamEncoder("m")
+    out = []
+    out += enc.feed({"choices": [{"delta": {"tool_calls": [
+        {"index": 0, "id": "a", "function": {"name": "fa", "arguments": '{"a"'}},
+    ]}}]})
+    out += enc.feed({"choices": [{"delta": {"tool_calls": [
+        {"index": 1, "id": "b", "function": {"name": "fb", "arguments": '{"b"'}},
+    ]}}]})
+    out += enc.feed({"choices": [{"delta": {"tool_calls": [
+        {"index": 0, "function": {"arguments": ': 1}'}},
+        {"index": 1, "function": {"arguments": ': 2}'}},
+    ]}}]})
+    out += enc.finish()
+    payloads = _payloads(out)
+    deltas = [p for p in payloads if p.get("type") == "content_block_delta"]
+    blocks = [p for p in payloads if p.get("type") == "content_block_start"]
+    by_index = {}
+    for d in deltas:
+        by_index.setdefault(d["index"], []).append(d["delta"]["partial_json"])
+    names = {b["index"]: b["content_block"]["name"] for b in blocks}
+    joined = {names[i]: json.loads("".join(frags))
+              for i, frags in by_index.items()}
+    assert joined == {"fa": {"a": 1}, "fb": {"b": 2}}
 
 
 def test_messages_endpoint_non_stream_and_stream():

@@ -1,0 +1,135 @@
+"""C++ native components vs their Python twins on identical inputs."""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from llmlb_tpu.native import (
+    NativeSafetensors,
+    NativeSseScanner,
+    load_native,
+    native_chain_hash,
+)
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native toolchain unavailable"
+)
+
+
+def test_chain_hash_matches_hashlib():
+    prev = "0" * 64
+    entries = [b'["a",1]', b'["b",2]', "unicode-é".encode()]
+    expected = hashlib.sha256(prev.encode() + b"".join(entries)).hexdigest()
+    assert native_chain_hash(prev, entries) == expected
+    # empty batch, long entries, 1-byte entries
+    assert native_chain_hash(prev, []) == hashlib.sha256(prev.encode()).hexdigest()
+    big = [b"x" * 100_000, b"y"]
+    assert native_chain_hash(prev, big) == hashlib.sha256(
+        prev.encode() + b"".join(big)).hexdigest()
+
+
+def test_audit_batch_hash_uses_native_consistently():
+    """audit.batch_hash must produce the same digest whether or not the
+    native library is loaded (the chain must survive a build change)."""
+    import time
+
+    from llmlb_tpu.gateway import audit as audit_mod
+
+    entries = [
+        audit_mod.AuditEntry(ts=time.time(), method="GET", path="/x",
+                             status=200, duration_ms=1.0)
+        for _ in range(5)
+    ]
+    native_digest = audit_mod.batch_hash("0" * 64, entries)
+    h = hashlib.sha256()
+    h.update(("0" * 64).encode())
+    for e in entries:
+        h.update(e.canonical().encode())
+    assert native_digest == h.hexdigest()
+
+
+def test_safetensors_reader_matches_safetensors_package(tmp_path):
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    tensors = {
+        "model.layers.0.w": rng.standard_normal((16, 8)).astype(np.float32),
+        "bias": rng.standard_normal((8,)).astype(np.float16),
+        "ids": np.arange(10, dtype=np.int64),
+        "scalarish": np.ones((1,), np.float32),
+    }
+    path = str(tmp_path / "m.safetensors")
+    save_file(tensors, path, metadata={"format": "pt"})
+
+    reader = NativeSafetensors(path)
+    assert sorted(reader.keys()) == sorted(tensors)
+    for name, ref in tensors.items():
+        got = reader.get_tensor(name)
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        np.testing.assert_array_equal(np.array(got), ref)
+    reader.close()
+
+
+def test_safetensors_reader_bf16(tmp_path):
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    arr = np.arange(24, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(4, 6)
+    path = str(tmp_path / "bf16.safetensors")
+    save_file({"w": arr}, path)
+    reader = NativeSafetensors(path)
+    got = np.array(reader.get_tensor("w"))
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_safetensors_reader_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.safetensors")
+    with open(path, "wb") as f:
+        f.write(b"\xff" * 64)
+    with pytest.raises(ValueError):
+        NativeSafetensors(path)
+    with pytest.raises(ValueError):
+        NativeSafetensors(str(tmp_path / "missing.safetensors"))
+
+
+def _sse_frames(payloads):
+    return b"".join(
+        b"data: " + json.dumps(p).encode() + b"\n\n" for p in payloads
+    ) + b"data: [DONE]\n\n"
+
+
+def test_sse_scanner_matches_python_accumulator():
+    from llmlb_tpu.gateway.token_accounting import StreamingTokenAccumulator
+
+    stream = _sse_frames([
+        {"choices": [{"delta": {"content": "hel"}}]},
+        {"choices": [{"delta": {"content": "lo"}}]},
+        {"choices": [], "usage": {"prompt_tokens": 11, "completion_tokens": 2}},
+    ])
+    scanner = NativeSseScanner()
+    # ragged feeding: split at awkward boundaries
+    for i in range(0, len(stream), 7):
+        scanner.feed(stream[i:i + 7])
+    assert scanner.frames == 3
+    assert scanner.usage() == (11, 2)
+
+    acc = StreamingTokenAccumulator()
+    for i in range(0, len(stream), 7):
+        acc.feed(stream[i:i + 7])
+    assert acc.finalize() == (11, 2, True)
+
+
+def test_sse_scanner_responses_api_usage_and_no_usage():
+    scanner = NativeSseScanner()
+    scanner.feed(_sse_frames([
+        {"type": "response.output_text.delta", "delta": "x"},
+        {"type": "response.completed",
+         "response": {}, "usage": {"input_tokens": 4, "output_tokens": 9}},
+    ]))
+    assert scanner.usage() == (4, 9)
+
+    empty = NativeSseScanner()
+    empty.feed(_sse_frames([{"choices": [{"delta": {"content": "x"}}]}]))
+    assert empty.usage() is None
