@@ -67,6 +67,14 @@ def _open_shard(path: str):
         return safe_open(path, framework="numpy")
 
 
+def _close_shard(shard) -> None:
+    """Release a reader from _open_shard (NativeSafetensors or safe_open)."""
+    if hasattr(shard, "close"):
+        shard.close()
+    elif hasattr(shard, "__exit__"):
+        shard.__exit__(None, None, None)
+
+
 def _safetensors_getter(model_dir: str) -> TensorGetter:
     """Build a name→tensor getter over all *.safetensors shards in a directory."""
     index_path = os.path.join(model_dir, "model.safetensors.index.json")
@@ -78,8 +86,11 @@ def _safetensors_getter(model_dir: str) -> TensorGetter:
         for fname in sorted(os.listdir(model_dir)):
             if fname.endswith(".safetensors"):
                 shard = _open_shard(os.path.join(model_dir, fname))
-                for name in shard.keys():
-                    name_to_file[name] = fname
+                try:
+                    for name in shard.keys():
+                        name_to_file[name] = fname
+                finally:
+                    _close_shard(shard)  # native readers mmap the whole file
     handles: dict[str, object] = {}
 
     def get(name: str) -> np.ndarray:

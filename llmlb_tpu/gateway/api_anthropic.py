@@ -401,7 +401,8 @@ async def messages(request: web.Request) -> web.StreamResponse:
 
     if is_stream:
         return await _stream_transform(
-            request, state, upstream, endpoint, canonical, started, lease, body
+            request, state, upstream, endpoint, canonical, started, lease,
+            body, openai_body,
         )
 
     raw = await upstream.read()
@@ -423,15 +424,19 @@ async def messages(request: web.Request) -> web.StreamResponse:
 
 
 async def _stream_transform(
-    request, state, upstream, endpoint, model, started, lease, original_body
+    request, state, upstream, endpoint, model, started, lease,
+    original_body, openai_body,
 ) -> web.StreamResponse:
     resp = web.StreamResponse(
         status=200, headers={"Content-Type": "text/event-stream"}
     )
     await resp.prepare(request)
     lease.complete()
+    # Estimate from the flattened OpenAI conversion: it folds system prompts
+    # and content-block (tool) messages into plain strings, which the raw
+    # Anthropic body does not.
     prompt_text = "\n".join(
-        m.get("content") for m in original_body.get("messages", [])
+        m.get("content") for m in openai_body.get("messages", [])
         if isinstance(m, dict) and isinstance(m.get("content"), str)
     )
     encoder = AnthropicStreamEncoder(

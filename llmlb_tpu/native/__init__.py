@@ -72,19 +72,20 @@ def ensure_native_built() -> bool:
     process startup (server mains, test setup), never from a request path."""
     global _build_attempted
     with _lib_lock:
-        if os.path.exists(_LIB_PATH):
-            return True
         if _build_attempted:
-            return False
+            return os.path.exists(_LIB_PATH)
         _build_attempted = True
         try:
+            # Always invoke make: its dependency tracking rebuilds the .so when
+            # the C++ sources changed (a stale library would otherwise be used
+            # silently) and is a near-no-op when fresh.
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR],
                 check=True, capture_output=True, timeout=120,
             )
         except Exception as e:
             log.info("native build unavailable (%s); using Python fallbacks", e)
-            return False
+            return os.path.exists(_LIB_PATH)
     return os.path.exists(_LIB_PATH)
 
 
@@ -171,8 +172,11 @@ class NativeSafetensors:
         return arr.reshape(tuple(shape[d] for d in range(ndim)))
 
     def close(self):
-        if self._handle is not None:
-            self._lib.st_close(self._handle)
+        # getattr: __init__ may raise before _handle is assigned (native lib
+        # unavailable) and __del__ still runs on the half-constructed object.
+        handle = getattr(self, "_handle", None)
+        if handle is not None:
+            self._lib.st_close(handle)
             self._handle = None
 
     def __del__(self):

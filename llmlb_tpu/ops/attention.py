@@ -17,10 +17,28 @@ this XLA version is the correctness baseline it is checked against.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30  # large finite value: -inf breaks softmax rows that are fully masked
+
+
+def _pallas_enabled() -> bool:
+    """Route to the Pallas kernels (ops/pallas_attention.py)?
+
+    `LLMLB_TPU_ATTENTION=pallas|xla` forces a path; `auto` (default) picks
+    Pallas on an unpartitioned TPU. A pallas_call is opaque to XLA sharding
+    propagation, so multi-device meshes keep the einsum path unless the caller
+    wraps the step in shard_map and forces `pallas`.
+    """
+    mode = os.environ.get("LLMLB_TPU_ATTENTION", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    return jax.default_backend() == "tpu" and jax.device_count() == 1
 
 
 def _split_gqa(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
@@ -36,6 +54,10 @@ def gqa_attention_prefill(
     prompt_lens: jnp.ndarray,  # [B] int32 — tokens beyond this are padding
 ) -> jnp.ndarray:
     """Causal self-attention over a freshly-prefilled prompt. Returns [B, T, H, D]."""
+    if _pallas_enabled():
+        from llmlb_tpu.ops.pallas_attention import flash_prefill
+
+        return flash_prefill(q, k, v, prompt_lens)
     b, t, h, d = q.shape
     k_heads = k.shape[2]
     qg = _split_gqa(q, k_heads)
@@ -67,6 +89,10 @@ def gqa_attention_decode(
     kv_lens: jnp.ndarray,  # [B] int32 — valid cache length per slot (incl. current)
 ) -> jnp.ndarray:
     """One-token decode attention against the full slot cache. Returns [B, 1, H, D]."""
+    if _pallas_enabled():
+        from llmlb_tpu.ops.pallas_attention import flash_decode
+
+        return flash_decode(q[:, 0], k_cache, v_cache, kv_lens)[:, None]
     b, t, h, d = q.shape
     k_heads = k_cache.shape[2]
     qg = _split_gqa(q, k_heads)  # [B, 1, K, G, D]

@@ -1,0 +1,322 @@
+"""Pallas TPU attention kernels: ragged flash-decode + causal flash-prefill.
+
+These are the hot ops of the serving engine (SURVEY.md §7 phase 4: "ragged paged
+attention Pallas kernel"). The XLA einsum paths in ops/attention.py are the
+correctness baselines; these kernels replace them on TPU:
+
+- `flash_decode`: one-token GQA attention against the slot KV cache. Grid is
+  (batch, kv_block) with the kv-block axis innermost, so Pallas's grid pipeline
+  double-buffers the next KV block's DMA behind the current block's compute.
+  Online softmax (m/l/acc) lives in VMEM scratch across the kv-block sweep.
+  Raggedness: per-slot `kv_lens` arrive via scalar prefetch (SMEM) and blocks
+  past the valid length skip their FLOPs entirely (`pl.when`) — decode cost
+  scales with the *actual* context, not the slot capacity.
+- `flash_prefill`: causal self-attention over bucketed prompts. Grid is
+  (batch, q_block, kv_block); fully-future KV blocks (k_start > q_end) skip
+  compute, giving the ~2x causal FLOP saving dense XLA attention leaves on the
+  table. The GQA group dim is folded into the q-row dim so the MXU sees
+  [BLK_Q*G, D] x [D, BLK_K] matmuls instead of G tiny ones.
+
+Mosaic tiling: blocks always take the FULL trailing (heads, head_dim) dims —
+the lowering requires the last two block dims be (8,128)-aligned *or* equal to
+the array dims, and "equal" holds for any head count this way. KV heads are
+iterated with a static (unrolled) loop inside the kernel.
+
+Numerics match the XLA baselines: fp32 scores/softmax/accumulation
+(`preferred_element_type`), finite -1e30 masking (fully-masked rows stay NaN-free).
+
+Multi-device note: a `pallas_call` is opaque to XLA's sharding propagation, so
+the dispatcher in ops/attention.py only routes here when the computation is not
+partitioned over devices (single-chip serving, or inside `shard_map`).
+
+The reference has no counterpart (it proxies inference — SURVEY.md L0); design
+follows the public ragged-paged-attention pattern (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # finite: keeps fully-masked softmax rows NaN-free
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _online_update(m_ref, l_ref, acc_ref, idx, scores, v):
+    """One online-softmax accumulation step into scratch rows `idx`."""
+    m_prev = m_ref[idx]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)  # f32
+    l_ref[idx] = l_ref[idx] * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[idx] = acc_ref[idx] * correction + pv
+    m_ref[idx] = m_new
+
+
+# ---------------------------------------------------------------------------
+# Decode: q [B, H, D] vs slot cache [B, S, K, D], ragged kv_lens [B]
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    # scalar prefetch
+    kv_lens_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, K, G, D]
+    k_ref,  # [1, BLK, K, D]
+    v_ref,  # [1, BLK, K, D]
+    # output
+    o_ref,  # [1, K, G, D]
+    # scratch
+    m_ref,  # [K, G, 1] f32
+    l_ref,  # [K, G, 1] f32
+    acc_ref,  # [K, G, D] f32
+    *,
+    block_k: int,
+    num_kv: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+    kv_len = kv_lens_ref[b]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s * block_k < kv_len)
+    def _compute():
+        col = s * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), dimension=1
+        )
+        valid = col < kv_len  # [1, BLK]
+        for h in range(num_kv):  # static unroll over KV heads
+            q = q_ref[0, h]  # [G, D]
+            k = k_ref[0, :, h, :]  # [BLK, D]
+            v = v_ref[0, :, h, :]
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, BLK]
+            scores = jnp.where(valid, scores, _NEG_INF)
+            _online_update(m_ref, l_ref, acc_ref, h, scores, v)
+
+    @pl.when(s == num_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def flash_decode(
+    q: jnp.ndarray,  # [B, H, D]
+    k_cache: jnp.ndarray,  # [B, S, K, D]
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    kv_lens: jnp.ndarray,  # [B] int32 — valid cache length per slot
+    *,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Ragged one-token GQA decode attention. Returns [B, H, D] in q.dtype."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    s = k_cache.shape[1]
+    num_kv = k_cache.shape[2]
+    g = h // num_kv
+    blk = min(block_k, s)
+    num_blocks = pl.cdiv(s, blk)
+    qg = q.reshape(b, num_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, num_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_kv, g, d), lambda bi, si, lens: (bi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk, num_kv, d), lambda bi, si, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk, num_kv, d), lambda bi, si, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_kv, g, d), lambda bi, si, lens: (bi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_k=blk, num_kv=num_kv, scale=d**-0.5
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(kv_lens.astype(jnp.int32), qg, k_cache, v_cache)
+    return out.reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Prefill: causal q [B, T, H, D] vs fresh k/v [B, T, K, D], ragged prompt_lens
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    prompt_lens_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, BLK_Q, K, G, D]
+    k_ref,  # [1, BLK_K, K, D]
+    v_ref,  # [1, BLK_K, K, D]
+    # output
+    o_ref,  # [1, BLK_Q, K, G, D]
+    # scratch
+    m_ref,  # [K, BLK_Q * G, 1] f32
+    l_ref,  # [K, BLK_Q * G, 1] f32
+    acc_ref,  # [K, BLK_Q * G, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    num_kv: int,
+    groups: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+    prompt_len = prompt_lens_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    rows = block_q * groups
+    # causal skip: the whole KV block is in the future of the whole Q block
+    not_all_future = k_start <= q_start + block_q - 1
+    # ragged skip: the whole KV block is beyond the prompt
+    in_prompt = k_start < prompt_len
+
+    @pl.when(jnp.logical_and(not_all_future, in_prompt))
+    def _compute():
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), dimension=0)
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), dimension=1
+        )
+        q_pos = q_start + row // groups
+        mask = (col <= q_pos) & (col < prompt_len)
+        for h in range(num_kv):  # static unroll over KV heads
+            q = q_ref[0, :, h].reshape(rows, -1)  # [BLK_Q*G, D]; t slow, g fast
+            k = k_ref[0, :, h, :]  # [BLK_K, D]
+            v = v_ref[0, :, h, :]
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [BLK_Q*G, BLK_K]
+            scores = jnp.where(mask, scores, _NEG_INF)
+            _online_update(m_ref, l_ref, acc_ref, h, scores, v)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[:] / l_safe).astype(o_ref.dtype)  # [K, BLK_Q*G, D]
+        o_ref[0] = out.reshape(num_kv, block_q, groups, -1).transpose(1, 0, 2, 3)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_prefill(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, K, D]
+    v: jnp.ndarray,  # [B, T, K, D]
+    prompt_lens: jnp.ndarray,  # [B] int32
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal ragged GQA prefill attention. Returns [B, T, H, D] in q.dtype."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    num_kv = k.shape[2]
+    g = h // num_kv
+    blk_q = min(block_q, t)
+    blk_k = min(block_k, t)
+    grid = (b, pl.cdiv(t, blk_q), pl.cdiv(t, blk_k))
+    qg = q.reshape(b, t, num_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, blk_q, num_kv, g, d),
+                lambda bi, qi, si, lens: (bi, qi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk_k, num_kv, d), lambda bi, qi, si, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk_k, num_kv, d), lambda bi, qi, si, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, num_kv, g, d),
+            lambda bi, qi, si, lens: (bi, qi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _prefill_kernel,
+            block_q=blk_q,
+            block_k=blk_k,
+            num_kv=num_kv,
+            groups=g,
+            scale=d**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, t, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(prompt_lens.astype(jnp.int32), qg, k, v)
+    return out.reshape(b, t, h, d)

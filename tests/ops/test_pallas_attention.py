@@ -1,0 +1,161 @@
+"""Pallas attention kernels vs the XLA einsum baselines (interpret mode on CPU).
+
+Mirrors the reference's unit-tier strategy (SURVEY.md §4): pure-logic numeric
+checks, no hardware dependency — `interpret=True` runs the same kernel the TPU
+compiles, through the Pallas interpreter.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmlb_tpu.ops.attention import gqa_attention_decode, gqa_attention_prefill
+from llmlb_tpu.ops.pallas_attention import flash_decode, flash_prefill
+
+
+@pytest.fixture(autouse=True)
+def _pin_baseline_to_xla(monkeypatch):
+    """On a 1-chip TPU host the baselines would auto-dispatch to Pallas and the
+    comparisons would become pallas-vs-pallas; pin the expected path to XLA.
+    (test_model_dispatch_pallas_matches_xla overrides this per-mode.)"""
+    monkeypatch.setenv("LLMLB_TPU_ATTENTION", "xla")
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize(
+    "b,h,kv,d,s,block_k",
+    [
+        (2, 8, 8, 32, 64, 32),  # MHA, multiple blocks
+        (3, 8, 2, 16, 96, 32),  # GQA g=4, S divisible
+        (2, 4, 1, 32, 40, 32),  # MQA, ragged last block (40 = 32 + 8)
+        (1, 8, 4, 64, 128, 128),  # single block covers everything
+    ],
+)
+def test_flash_decode_matches_xla(b, h, kv, d, s, block_k):
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = _rand(keys[0], (b, 1, h, d))
+    k_cache = _rand(keys[1], (b, s, kv, d))
+    v_cache = _rand(keys[2], (b, s, kv, d))
+    kv_lens = jax.random.randint(keys[3], (b,), 1, s + 1, jnp.int32)
+
+    expected = gqa_attention_decode(q, k_cache, v_cache, kv_lens)
+    got = flash_decode(
+        q[:, 0], k_cache, v_cache, kv_lens, block_k=block_k, interpret=True
+    )
+    np.testing.assert_allclose(got, expected[:, 0], rtol=2e-5, atol=2e-5)
+
+
+def test_flash_decode_extreme_lens():
+    """kv_len=1 (only first token valid) and kv_len=S (fully dense)."""
+    b, h, kv, d, s = 2, 4, 2, 16, 48
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand(keys[0], (b, 1, h, d))
+    k_cache = _rand(keys[1], (b, s, kv, d))
+    v_cache = _rand(keys[2], (b, s, kv, d))
+    kv_lens = jnp.array([1, s], jnp.int32)
+
+    expected = gqa_attention_decode(q, k_cache, v_cache, kv_lens)
+    got = flash_decode(
+        q[:, 0], k_cache, v_cache, kv_lens, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(got, expected[:, 0], rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "b,t,h,kv,d,block_q,block_k",
+    [
+        (2, 64, 8, 8, 32, 32, 32),  # MHA
+        (2, 64, 8, 2, 16, 16, 32),  # GQA g=4, blk_q != blk_k
+        (1, 40, 4, 1, 32, 32, 32),  # MQA, ragged T
+        (2, 128, 8, 4, 64, 128, 128),  # single q/k block
+    ],
+)
+def test_flash_prefill_matches_xla(b, t, h, kv, d, block_q, block_k):
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = _rand(keys[0], (b, t, h, d))
+    k = _rand(keys[1], (b, t, kv, d))
+    v = _rand(keys[2], (b, t, kv, d))
+    prompt_lens = jax.random.randint(keys[3], (b,), 1, t + 1, jnp.int32)
+
+    expected = gqa_attention_prefill(q, k, v, prompt_lens)
+    got = flash_prefill(
+        q, k, v, prompt_lens, block_q=block_q, block_k=block_k, interpret=True
+    )
+    # Padding rows (t >= prompt_len) are ignored downstream; compare valid rows.
+    lens = np.asarray(prompt_lens)
+    for bi in range(b):
+        np.testing.assert_allclose(
+            got[bi, : lens[bi]],
+            expected[bi, : lens[bi]],
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
+
+def test_flash_prefill_full_lens_all_rows():
+    """With prompt_lens == T every row must match, padding included."""
+    b, t, h, kv, d = 2, 48, 4, 2, 32
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(keys[0], (b, t, h, d))
+    k = _rand(keys[1], (b, t, kv, d))
+    v = _rand(keys[2], (b, t, kv, d))
+    prompt_lens = jnp.full((b,), t, jnp.int32)
+
+    expected = gqa_attention_prefill(q, k, v, prompt_lens)
+    got = flash_prefill(
+        q, k, v, prompt_lens, block_q=16, block_k=16, interpret=True
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_model_dispatch_pallas_matches_xla(monkeypatch):
+    """Full model prefill+decode with LLMLB_TPU_ATTENTION=pallas vs =xla.
+
+    Uses shapes unique to this test: the jit cache is keyed on shapes/config,
+    and the dispatch env var is read at trace time.
+    """
+    import numpy as np
+
+    from llmlb_tpu.models.llama import (
+        LlamaConfig,
+        decode_step,
+        init_kv_cache,
+        init_params,
+        prefill,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    batch, seq, capacity = 3, 24, 48
+    ids = jax.random.randint(jax.random.PRNGKey(8), (batch, seq), 0, 128)
+    lens = jnp.array([24, 10, 17], jnp.int32)
+
+    results = {}
+    for mode in ("xla", "pallas"):
+        monkeypatch.setenv("LLMLB_TPU_ATTENTION", mode)
+        prefill._clear_cache()
+        decode_step._clear_cache()
+        ck, cv = init_kv_cache(cfg, batch, capacity)
+        logits, ck, cv = prefill(params, cfg, ids, lens, ck, cv)
+        toks = jnp.argmax(logits, -1).astype(jnp.int32)
+        logits2, ck, cv = decode_step(params, cfg, toks, lens, ck, cv)
+        results[mode] = (np.asarray(logits), np.asarray(logits2))
+
+    np.testing.assert_allclose(
+        results["pallas"][0], results["xla"][0], rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        results["pallas"][1], results["xla"][1], rtol=1e-4, atol=1e-4
+    )
