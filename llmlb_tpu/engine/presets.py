@@ -12,9 +12,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from llmlb_tpu.models.llama import LlamaConfig
+from llmlb_tpu.models.mixtral import MixtralConfig
 from llmlb_tpu.ops.rope import RopeScaling
 
 PRESETS: dict[str, LlamaConfig] = {
+    # sparse-MoE flagship (BASELINE.json config #5: multi-slice v5e target);
+    # served via models/mixtral.py with experts on the mesh ep axis
+    "mixtral-8x7b": MixtralConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1000000.0,
+        rms_eps=1e-5, max_position_embeddings=32768,
+        num_experts=8, experts_per_token=2,
+    ),
+    # CI-sized MoE config for unit tests and the multichip dry-run
+    "debug-moe-tiny": MixtralConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, dtype=jnp.float32,
+        max_position_embeddings=128, num_experts=4, experts_per_token=2,
+    ),
     # flagship serving target (BASELINE.json config #2)
     "llama-3-8b": LlamaConfig(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import queue
 import threading
 import time
@@ -29,15 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llmlb_tpu.models.llama import (
-    LlamaConfig,
-    Params,
-    decode_step,
-    init_kv_cache,
-    kv_cache_shardings,
-    param_shardings,
-    prefill_into_slots,
-)
+from llmlb_tpu.models import family_for
+from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
 from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
 
@@ -103,6 +97,9 @@ class EngineCore:
         seed: int = 0,
     ):
         self.cfg = cfg
+        # Family module (llama / mixtral) supplying the serving fns — one
+        # shared contract, so dense and MoE models run the same loop.
+        self.family = family_for(cfg)
         self.num_slots = num_slots
         self.slot_capacity = min(slot_capacity, cfg.max_position_embeddings)
         self.prefill_buckets = tuple(
@@ -112,21 +109,25 @@ class EngineCore:
 
         devices = jax.devices()
         if mesh_config is None:
-            tp = default_tp(len(devices), cfg.num_heads, cfg.num_kv_heads)
-            mesh_config = MeshConfig(dp=len(devices) // tp, tp=tp)
+            n = len(devices)
+            ep = 1
+            if getattr(cfg, "num_experts", 0) > 1:
+                # MoE default: give experts as much of the mesh as divides both
+                # the device count and the expert count, tp/dp with the rest.
+                ep = math.gcd(n, cfg.num_experts)
+            tp = default_tp(n // ep, cfg.num_heads, cfg.num_kv_heads)
+            mesh_config = MeshConfig(dp=n // (ep * tp), ep=ep, tp=tp)
         self.mesh = build_mesh(mesh_config, devices=devices)
 
         if params is None:
-            from llmlb_tpu.models.llama import init_params
-
-            params = init_params(cfg, jax.random.PRNGKey(seed))
-        shardings = param_shardings(cfg, self.mesh)
+            params = self.family.init_params(cfg, jax.random.PRNGKey(seed))
+        shardings = self.family.param_shardings(cfg, self.mesh)
         self.params = {
             k: jax.device_put(v, shardings[k]) for k, v in params.items()
         }
 
-        ck, cv = init_kv_cache(cfg, num_slots, self.slot_capacity)
-        ck_sh, cv_sh = kv_cache_shardings(cfg, self.mesh)
+        ck, cv = self.family.init_kv_cache(cfg, num_slots, self.slot_capacity)
+        ck_sh, cv_sh = self.family.kv_cache_shardings(cfg, self.mesh)
         self.cache_k = jax.device_put(ck, ck_sh)
         self.cache_v = jax.device_put(cv, cv_sh)
 
@@ -227,8 +228,8 @@ class EngineCore:
                 time.sleep(0.001)
 
     def _reset_caches(self) -> None:
-        ck, cv = init_kv_cache(self.cfg, self.num_slots, self.slot_capacity)
-        ck_sh, cv_sh = kv_cache_shardings(self.cfg, self.mesh)
+        ck, cv = self.family.init_kv_cache(self.cfg, self.num_slots, self.slot_capacity)
+        ck_sh, cv_sh = self.family.kv_cache_shardings(self.cfg, self.mesh)
         self.cache_k = jax.device_put(ck, ck_sh)
         self.cache_v = jax.device_put(cv, cv_sh)
         self._seq_lens[:] = 0
@@ -257,7 +258,7 @@ class EngineCore:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = request.prompt_ids
 
-        logits, self.cache_k, self.cache_v = prefill_into_slots(
+        logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
             self.params,
             self.cfg,
             jnp.asarray(ids),
@@ -265,6 +266,7 @@ class EngineCore:
             jnp.asarray([slot_id], np.int32),
             self.cache_k,
             self.cache_v,
+            self.mesh,
         )
 
         slot = self.slots[slot_id]
@@ -298,13 +300,14 @@ class EngineCore:
             return False
 
         self._key, sk = jax.random.split(self._key)
-        logits, self.cache_k, self.cache_v = decode_step(
+        logits, self.cache_k, self.cache_v = self.family.decode_step(
             self.params,
             self.cfg,
             self._d_last_tokens,
             self._d_seq_lens,
             self.cache_k,
             self.cache_v,
+            self.mesh,
         )
         tokens_dev = sample_tokens(
             logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks

@@ -93,6 +93,9 @@ class EngineAPI:
     # ------------------------------------------------------------- inventory
 
     async def list_models(self, request: web.Request) -> web.Response:
+        caps = ["chat_completion"]
+        if self.engine.supports_embeddings():
+            caps.append("embeddings")
         return web.json_response(
             {
                 "object": "list",
@@ -102,8 +105,57 @@ class EngineAPI:
                         "object": "model",
                         "created": 0,
                         "owned_by": "llmlb_tpu",
+                        # advertised so the gateway's model sync can assign
+                        # capabilities without name heuristics
+                        "capabilities": caps,
                     }
                 ],
+            }
+        )
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: input may be a string, list of strings, or
+        list of token-id lists."""
+        body = await request.json()
+        raw = body.get("input")
+        if raw is None:
+            return _error(400, "'input' is required")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw
+        ):
+            inputs = [raw]  # single pre-tokenized input
+        elif isinstance(raw, list) and raw:
+            inputs = raw
+        else:
+            return _error(400, "'input' must be a non-empty string or array")
+
+        batch_ids: list[list[int]] = []
+        for item in inputs:
+            if isinstance(item, str):
+                batch_ids.append(self.engine.tokenizer.encode(item))
+            elif isinstance(item, list) and all(isinstance(x, int) for x in item):
+                batch_ids.append([int(x) for x in item])
+            else:
+                return _error(400, "each input must be a string or token array")
+        try:
+            vectors = await self.engine.embed(batch_ids)
+        except ValueError as e:
+            return _error(400, str(e))
+        prompt_tokens = sum(len(x) for x in batch_ids)
+        return web.json_response(
+            {
+                "object": "list",
+                "model": body.get("model", self.engine.model_id),
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": vec}
+                    for i, vec in enumerate(vectors)
+                ],
+                "usage": {
+                    "prompt_tokens": prompt_tokens,
+                    "total_tokens": prompt_tokens,
+                },
             }
         )
 
@@ -452,6 +504,7 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True) -> web.Applic
     app.router.add_post("/v1/chat/completions", api.chat_completions)
     app.router.add_post("/v1/completions", api.completions)
     app.router.add_post("/v1/responses", api.responses)
+    app.router.add_post("/v1/embeddings", api.embeddings)
     app.router.add_get("/api/health", api.health)
     app.router.add_get("/api/system", api.system)
 

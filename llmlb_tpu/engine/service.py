@@ -192,6 +192,80 @@ class Engine:
         assert final is not None
         return dataclasses.replace(final, text="".join(text))
 
+    # One embed forward never exceeds this many rows: keeps a single request
+    # from monopolizing HBM/compile time (generation is bounded by num_slots;
+    # this is the embedding-path equivalent).
+    _EMBED_CHUNK = 64
+    MAX_EMBED_INPUTS = 2048  # request-level cap, matches OpenAI's limit
+
+    def _embed_sync(self, batch_ids: list[list[int]]) -> "list[list[float]]":
+        import numpy as np
+
+        results: list[list[float]] = []
+        for start in range(0, len(batch_ids), self._EMBED_CHUNK):
+            chunk = batch_ids[start : start + self._EMBED_CHUNK]
+            n = len(chunk)
+            longest = max(len(x) for x in chunk)
+            # pow2 buckets on BOTH dims keep the compile count logarithmic;
+            # padding rows (lens=1 over zero ids) are sliced off below.
+            bucket = 16
+            while bucket < longest:
+                bucket *= 2
+            n_bucket = 1
+            while n_bucket < n:
+                n_bucket *= 2
+            ids = np.zeros((n_bucket, bucket), np.int32)
+            lens = np.ones((n_bucket,), np.int32)
+            for i, toks in enumerate(chunk):
+                ids[i, : len(toks)] = toks
+                lens[i] = len(toks)
+            out = self.core.family.encode(
+                self.core.params, self.core.cfg, ids, lens
+            )
+            results.extend(np.asarray(out)[:n].tolist())
+        return results
+
+    def supports_embeddings(self) -> bool:
+        """Capability by family contract: a family supports /v1/embeddings iff
+        it exports an `encode` forward (the registry is the extension point)."""
+        return hasattr(self.core.family, "encode")
+
+    async def embed(self, batch_ids: list[list[int]]) -> "list[list[float]]":
+        """Batch of token id lists -> L2-normalized embedding vectors.
+
+        Raises ValueError (a client error) for empty/oversized/out-of-vocab
+        inputs and for model families without an embedding forward.
+        """
+        if not self.supports_embeddings():
+            raise ValueError(
+                "embeddings are not supported for the "
+                f"{self.core.family.__name__.rsplit('.', 1)[-1]} model family"
+            )
+        if not batch_ids or any(len(x) == 0 for x in batch_ids):
+            raise ValueError("each input must contain at least one token")
+        if len(batch_ids) > self.MAX_EMBED_INPUTS:
+            raise ValueError(
+                f"at most {self.MAX_EMBED_INPUTS} inputs per request "
+                f"(got {len(batch_ids)})"
+            )
+        longest = max(len(x) for x in batch_ids)
+        if longest > self.core.cfg.max_position_embeddings:
+            raise ValueError(
+                f"input of {longest} tokens exceeds the model context "
+                f"({self.core.cfg.max_position_embeddings})"
+            )
+        vocab = self.core.cfg.vocab_size
+        for toks in batch_ids:
+            for t in toks:
+                if not 0 <= t < vocab:
+                    raise ValueError(
+                        f"token id {t} out of range for vocab size {vocab}"
+                    )
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self._embed_sync, batch_ids
+        )
+
     def health(self) -> dict:
         from llmlb_tpu.engine.telemetry import device_telemetry
 

@@ -23,7 +23,7 @@ TensorGetter = Callable[[str], np.ndarray]
 
 
 def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
-    """Map HF llama/qwen2/mistral tensor names to our stacked pytree (numpy)."""
+    """Map HF llama/qwen2/mistral/mixtral tensor names to our stacked pytree."""
 
     def stack(fmt: str, transpose: bool) -> np.ndarray:
         leaves = []
@@ -31,6 +31,9 @@ def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
             w = get(fmt.format(i=i))
             leaves.append(w.T if transpose else w)
         return np.stack(leaves)
+
+    if getattr(cfg, "num_experts", 0) > 1:
+        return _convert_hf_moe(cfg, get, stack)
 
     params: dict = {
         "embed": get("model.embed_tokens.weight"),
@@ -49,6 +52,41 @@ def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
         params["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
         params["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
         params["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", False)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = get("lm_head.weight").T
+    return params
+
+
+def _convert_hf_moe(cfg, get: TensorGetter, stack) -> Params:
+    """Mixtral layout: block_sparse_moe.gate + experts.{e}.w1/w3/w2 per layer
+    (w1 = gate/silu branch, w3 = up, w2 = down in HF's naming)."""
+
+    def stack_experts(wname: str, transpose: bool) -> np.ndarray:
+        layers = []
+        for i in range(cfg.num_layers):
+            experts = []
+            for e in range(cfg.num_experts):
+                w = get(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{wname}.weight"
+                )
+                experts.append(w.T if transpose else w)
+            layers.append(np.stack(experts))
+        return np.stack(layers)  # [L, E_experts, ...]
+
+    params: dict = {
+        "embed": get("model.embed_tokens.weight"),
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight", True),
+        "router": stack("model.layers.{i}.block_sparse_moe.gate.weight", True),
+        "we_gate": stack_experts("w1", True),
+        "we_up": stack_experts("w3", True),
+        "we_down": stack_experts("w2", True),
+        "ln_attn": stack("model.layers.{i}.input_layernorm.weight", False),
+        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight", False),
+        "ln_final": get("model.norm.weight"),
+    }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = get("lm_head.weight").T
     return params
@@ -106,18 +144,24 @@ def load_config(model_dir: str, dtype=None) -> LlamaConfig:
     with open(os.path.join(model_dir, "config.json")) as f:
         hf = json.load(f)
     kwargs = {} if dtype is None else {"dtype": dtype}
+    if hf.get("model_type") == "mixtral" or hf.get("num_local_experts", 0) > 1:
+        from llmlb_tpu.models.mixtral import MixtralConfig
+
+        return MixtralConfig.from_hf_config(hf, **kwargs)
     return LlamaConfig.from_hf_config(hf, **kwargs)
 
 
 def load_checkpoint(model_dir: str, cfg: LlamaConfig, mesh=None) -> Params:
     """Load a HF checkpoint directory into (optionally sharded) device arrays."""
+    from llmlb_tpu.models import family_for
+
     get = _safetensors_getter(model_dir)
     host_params = convert_hf_tensors(cfg, get)
     if mesh is None:
         return jax.tree.map(
             lambda x: jax.numpy.asarray(x, dtype=cfg.dtype), host_params
         )
-    shardings = param_shardings(cfg, mesh)
+    shardings = family_for(cfg).param_shardings(cfg, mesh)
     return {
         name: jax.device_put(np.asarray(v, dtype=np.dtype(cfg.dtype)), shardings[name])
         for name, v in host_params.items()

@@ -21,6 +21,22 @@ from llmlb_tpu.gateway.types import Capability, Endpoint, EndpointModel, Endpoin
 log = logging.getLogger("llmlb_tpu.gateway.sync")
 
 
+def capabilities_from_meta(meta: dict) -> list[Capability] | None:
+    """Explicit capability advertisement in a /v1/models entry (our tpu://
+    engine emits this — engine/server.py list_models). Takes precedence over
+    name heuristics; unknown capability strings are ignored."""
+    raw = meta.get("capabilities")
+    if not isinstance(raw, list):
+        return None
+    out = []
+    for item in raw:
+        try:
+            out.append(Capability(str(item)))
+        except ValueError:
+            continue
+    return out or None
+
+
 def detect_capabilities(model_name: str) -> list[Capability]:
     """Name-based capability heuristics (parity: sync/capabilities.rs:47-57)."""
     lowered = model_name.lower()
@@ -87,7 +103,9 @@ async def fetch_endpoint_models(
                 endpoint_id=endpoint.id,
                 model_id=engine_name,
                 canonical_name=to_canonical(engine_name),
-                capabilities=detect_capabilities(engine_name),
+                capabilities=(
+                    capabilities_from_meta(meta) or detect_capabilities(engine_name)
+                ),
                 context_length=context_length,
             )
         )

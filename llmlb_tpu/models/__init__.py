@@ -1,3 +1,12 @@
+"""Model families served by the tpu:// engine.
+
+`family_for(cfg)` resolves the function module (init_params / param_shardings /
+kv_cache_shardings / init_kv_cache / prefill / prefill_into_slots / decode_step
+— one shared serving contract) for a config, so the engine scheduler is
+family-agnostic: dense Llama-class (llama.py) and sparse-MoE Mixtral-class
+(mixtral.py) plug into the same continuous-batching loop.
+"""
+
 from llmlb_tpu.models.llama import (
     LlamaConfig,
     init_params,
@@ -9,8 +18,21 @@ from llmlb_tpu.models.llama import (
     decode_step,
 )
 
+
+def family_for(cfg):
+    """Resolve the serving-function module for a model config."""
+    from llmlb_tpu.models import llama, mixtral
+
+    if isinstance(cfg, mixtral.MixtralConfig):
+        return mixtral
+    if isinstance(cfg, LlamaConfig):
+        return llama
+    raise TypeError(f"no model family for config type {type(cfg).__name__}")
+
+
 __all__ = [
     "LlamaConfig",
+    "family_for",
     "init_params",
     "param_shardings",
     "kv_cache_shardings",

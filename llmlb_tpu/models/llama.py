@@ -26,7 +26,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from llmlb_tpu.ops.attention import gqa_attention_decode, gqa_attention_prefill
 from llmlb_tpu.ops.norms import rms_norm
@@ -232,6 +232,21 @@ def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
 
 
+def _attn_block(cfg: LlamaConfig, lp: Params, x: jnp.ndarray, positions,
+                inv_freq, attn_fn):
+    """Shared pre-norm attention sub-block (every serving path uses this one
+    skeleton: norm → qkv → rope → attn_fn → wo residual). `attn_fn(q, k, v)`
+    supplies the attention flavor (dense prefill / cache decode / ring) and may
+    capture caches via closure. Returns (x_out, roped_k, roped_v)."""
+    b, t, _ = x.shape
+    h = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
+    q, k, v = _qkv(cfg, lp, h)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    attn = attn_fn(q, k, v)
+    return x + attn.reshape(b, t, -1) @ lp["wo"], k, v
+
+
 def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["ln_final"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
@@ -240,27 +255,36 @@ def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv):
-    """Shared prefill body; `write_kv(cache, new_kv, positions)` places K/V."""
+def _default_mlp_fn(lp: Params, h: jnp.ndarray, token_valid) -> jnp.ndarray:
+    return _mlp(lp, h)
+
+
+def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
+                  *, stacked_names=None, mlp_fn=_default_mlp_fn):
+    """Shared prefill body for every model family.
+
+    `write_kv(cache, new_kv, positions)` places K/V; `mlp_fn(lp, h,
+    token_valid)` is the per-family feed-forward (dense SwiGLU here, routed
+    experts for mixtral — token_valid marks non-padding tokens so MoE routing
+    can ignore padding)."""
     b, t = input_ids.shape
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+    token_valid = positions < prompt_lens[:, None]  # [B, T]
 
     x = params["embed"][input_ids]  # [B, T, E]
-    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
 
     def layer(carry_x, layer_in):
         lp, ck, cv = layer_in
-        h = rms_norm(carry_x, lp["ln_attn"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        carry_x, k, v = _attn_block(
+            cfg, lp, carry_x, positions, inv_freq,
+            lambda q, k, v: gqa_attention_prefill(q, k, v, prompt_lens),
+        )
         ck = write_kv(ck, k.astype(ck.dtype), positions)
         cv = write_kv(cv, v.astype(cv.dtype), positions)
-        attn = gqa_attention_prefill(q, k, v, prompt_lens)
-        carry_x = carry_x + attn.reshape(b, t, -1) @ lp["wo"]
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + _mlp(lp, h)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid)
         return carry_x, (ck, cv)
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
@@ -271,7 +295,42 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
     return logits, cache_k, cache_v
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
+                 *, stacked_names=None, mlp_fn=_default_mlp_fn):
+    """Shared one-token decode body for every model family."""
+    b = input_ids.shape[0]
+    capacity = cache_k.shape[2]
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    # Freed slots keep counting on device; clamp so their garbage writes stay
+    # inside the (ignored) row instead of relying on scatter OOB semantics.
+    write_pos = jnp.minimum(seq_lens, capacity - 1)
+    positions = write_pos[:, None]  # [B, 1]
+    batch_idx = jnp.arange(b)
+
+    x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
+    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+
+    def layer(carry_x, layer_in):
+        lp, ck, cv = layer_in
+
+        def attn_fn(q, k, v):
+            nonlocal ck, cv  # cache write precedes attention over the cache
+            ck = ck.at[batch_idx, write_pos].set(k[:, 0].astype(ck.dtype))
+            cv = cv.at[batch_idx, write_pos].set(v[:, 0].astype(cv.dtype))
+            return gqa_attention_decode(q, ck, cv, write_pos + 1)
+
+        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + mlp_fn(lp, h, None)
+        return carry_x, (ck, cv)
+
+    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
+    logits = _unembed(cfg, params, x[:, 0])
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
 def prefill(
     params: Params,
     cfg: LlamaConfig,
@@ -279,6 +338,8 @@ def prefill(
     prompt_lens: jnp.ndarray,  # [B] int32
     cache_k: jnp.ndarray,  # [L, B, S, K, D] — fresh slots, written at [0:T]
     cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused (GSPMD shards via param placement);
+    # accepted so all model families share one serving-call signature
 ):
     """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
     cache_k, cache_v)."""
@@ -291,7 +352,8 @@ def prefill(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
 def prefill_into_slots(
     params: Params,
     cfg: LlamaConfig,
@@ -300,6 +362,7 @@ def prefill_into_slots(
     slot_ids: jnp.ndarray,  # [B] int32 — target rows in the global slot cache
     cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D] — the engine's live cache
     cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
 ):
     """Prefill B prompts and scatter their KV into rows `slot_ids` of the live
     slot cache — the continuous-batching insert path (new requests land in freed
@@ -314,7 +377,109 @@ def prefill_into_slots(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache_k", "cache_v"))
+@partial(jax.jit, static_argnames=("cfg",))
+def encode(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """Text-embedding forward: full transformer pass (no KV writes), masked
+    mean-pool over valid tokens, L2-normalize. Returns [B, E] fp32.
+
+    Serves /v1/embeddings on the tpu:// engine — the reference only proxies
+    embeddings to external runtimes (api/openai.rs /v1/embeddings handler);
+    here the same decoder weights double as the embedding model, the common
+    practice for serving stacks without a dedicated embedder.
+    """
+    b, t = input_ids.shape
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+
+    x = params["embed"][input_ids]
+    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+
+    def layer(carry_x, lp):
+        carry_x, _, _ = _attn_block(
+            cfg, lp, carry_x, positions, inv_freq,
+            lambda q, k, v: gqa_attention_prefill(q, k, v, prompt_lens),
+        )
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + _mlp(lp, h)
+        return carry_x, None
+
+    x, _ = lax.scan(layer, x, stacked)
+    x = rms_norm(x, params["ln_final"], cfg.rms_eps).astype(jnp.float32)
+
+    valid = (jnp.arange(t, dtype=jnp.int32)[None, :] < prompt_lens[:, None])
+    pooled = (x * valid[..., None]).sum(1) / jnp.maximum(
+        prompt_lens[:, None].astype(jnp.float32), 1.0
+    )
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
+
+
+def make_context_parallel_prefill(cfg: LlamaConfig, mesh: Mesh):
+    """Long-context prefill with the sequence axis sharded over the mesh `sp`
+    axis (ring attention — ops/ring_attention.py).
+
+    Per-token ops (embed, norms, QKV/MLP matmuls, rope) shard trivially over
+    the token axis under GSPMD; attention is the only op coupling tokens, and
+    it runs as a shard_map ring so the full T×T score matrix never exists on
+    one chip. Composes with tp over heads when tp divides num_kv_heads (the
+    GQA group structure must split along kv-head boundaries); otherwise head
+    compute replicates inside the ring — still correct, just not tp-scaled.
+
+    Returns a jitted `fn(params, input_ids [B,T], prompt_lens [B]) ->
+    (last_logits [B,V] fp32, k_all [L,B,T,K,D], v_all)`. The caller scatters
+    k/v into its live slot cache (engine insert path) or keeps them
+    seq-sharded for context-parallel decode. New TPU-first design — the
+    reference has no long-context subsystem (SURVEY.md §5).
+    """
+    from llmlb_tpu.ops.ring_attention import ring_prefill_attention
+
+    shard_rules_for(cfg, mesh.shape["tp"])  # tp-divisibility validation
+    kv_shardable = cfg.num_kv_heads % mesh.shape["tp"] == 0
+    head_axis = "tp" if kv_shardable else None
+    seq_spec = NamedSharding(mesh, P("dp", "sp", None))
+
+    @jax.jit
+    def fn(params: Params, input_ids: jnp.ndarray, prompt_lens: jnp.ndarray):
+        b, t = input_ids.shape
+        inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+
+        x = params["embed"][input_ids]  # [B, T, E]
+        x = lax.with_sharding_constraint(x, seq_spec)
+        stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+
+        def layer(carry_x, lp):
+            carry_x, k, v = _attn_block(
+                cfg, lp, carry_x, positions, inv_freq,
+                lambda q, k, v: ring_prefill_attention(
+                    q, k, v, prompt_lens, mesh,
+                    head_axis=head_axis, kv_head_axis=head_axis,
+                ),
+            )
+            carry_x = lax.with_sharding_constraint(carry_x, seq_spec)
+            h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+            carry_x = carry_x + _mlp(lp, h)
+            carry_x = lax.with_sharding_constraint(carry_x, seq_spec)
+            return carry_x, (k, v)
+
+        x, (k_all, v_all) = lax.scan(layer, x, stacked)
+
+        last = jnp.maximum(prompt_lens - 1, 0)
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        logits = _unembed(cfg, params, x_last)
+        return logits, k_all.astype(cfg.dtype), v_all.astype(cfg.dtype)
+
+    return fn
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
 def decode_step(
     params: Params,
     cfg: LlamaConfig,
@@ -322,34 +487,7 @@ def decode_step(
     seq_lens: jnp.ndarray,  # [B] int32 — tokens already in cache (new token's position)
     cache_k: jnp.ndarray,  # [L, B, S, K, D]
     cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
 ):
     """One decode step across all slots. Returns (logits [B, V] fp32, caches)."""
-    b = input_ids.shape[0]
-    capacity = cache_k.shape[2]
-    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    # Freed slots keep counting on device; clamp so their garbage writes stay
-    # inside the (ignored) row instead of relying on scatter OOB semantics.
-    write_pos = jnp.minimum(seq_lens, capacity - 1)
-    positions = write_pos[:, None]  # [B, 1]
-    batch_idx = jnp.arange(b)
-
-    x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
-    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
-
-    def layer(carry_x, layer_in):
-        lp, ck, cv = layer_in
-        h = rms_norm(carry_x, lp["ln_attn"], cfg.rms_eps)
-        q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        ck = ck.at[batch_idx, write_pos].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[batch_idx, write_pos].set(v[:, 0].astype(cv.dtype))
-        attn = gqa_attention_decode(q, ck, cv, write_pos + 1)
-        carry_x = carry_x + attn.reshape(b, 1, -1) @ lp["wo"]
-        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + _mlp(lp, h)
-        return carry_x, (ck, cv)
-
-    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
-    logits = _unembed(cfg, params, x[:, 0])
-    return logits, cache_k, cache_v
+    return _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v)

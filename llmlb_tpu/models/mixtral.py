@@ -1,0 +1,213 @@
+"""Mixtral-family sparse-MoE decoder (Mixtral-8x7B/8x22B, Qwen-MoE-class).
+
+Same serving-shaped skeleton as models/llama.py (stacked layers + lax.scan,
+static-shape prefill/decode over slot KV caches, GQA attention ops) with the
+dense SwiGLU MLP swapped for top-k routed experts (ops/moe.py). Expert weights
+carry an `experts` logical axis mapped to the mesh `ep` axis, so a
+Mixtral-8x7B spans a multi-chip mesh as dp × ep × tp with GSPMD inserting the
+dispatch/combine all-to-alls (BASELINE.json config #5 class).
+
+The reference gateway does no inference and has no MoE (SURVEY.md §2.4); this
+model family is new TPU-native design for the in-tree tpu:// engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from llmlb_tpu.models.llama import LlamaConfig, _decode_impl, _prefill_impl
+from llmlb_tpu.ops.moe import default_capacity, moe_dense_exact, moe_dispatch_combine
+from llmlb_tpu.parallel.sharding import logical_to_sharding
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, dtype=jnp.bfloat16) -> "MixtralConfig":
+        base = LlamaConfig.from_hf_config(hf, dtype)
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        return cls(
+            **fields,
+            num_experts=hf.get("num_local_experts", hf.get("num_experts", 8)),
+            experts_per_token=hf.get("num_experts_per_tok", 2),
+        )
+
+
+def init_params(cfg: MixtralConfig, key: jax.Array) -> Params:
+    """Random init for tests/benches; serving loads HF checkpoints."""
+    d = cfg.head_dim_
+    h, k_, e = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
+    f, l_, x_ = cfg.intermediate_size, cfg.num_layers, cfg.num_experts
+    keys = iter(jax.random.split(key, 16))
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(
+            cfg.dtype
+        )
+
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, e), e),
+        "wq": w(next(keys), (l_, e, h * d), e),
+        "wk": w(next(keys), (l_, e, k_ * d), e),
+        "wv": w(next(keys), (l_, e, k_ * d), e),
+        "wo": w(next(keys), (l_, h * d, e), h * d),
+        "router": w(next(keys), (l_, e, x_), e),
+        "we_gate": w(next(keys), (l_, x_, e, f), e),
+        "we_up": w(next(keys), (l_, x_, e, f), e),
+        "we_down": w(next(keys), (l_, x_, f, e), f),
+        "ln_attn": jnp.ones((l_, e), cfg.dtype),
+        "ln_mlp": jnp.ones((l_, e), cfg.dtype),
+        "ln_final": jnp.ones((e,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (e, cfg.vocab_size), e)
+    return params
+
+
+def param_logical_axes(cfg: MixtralConfig) -> dict[str, tuple]:
+    axes = {
+        "embed": ("vocab", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "router": ("layers", "embed", None),  # router replicated: tiny
+        "we_gate": ("layers", "experts", "embed", "ffn"),
+        "we_up": ("layers", "experts", "embed", "ffn"),
+        "we_down": ("layers", "experts", "ffn", "embed"),
+        "ln_attn": ("layers", "embed"),
+        "ln_mlp": ("layers", "embed"),
+        "ln_final": ("embed",),
+    }
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# Same rules as the dense family (ShardingRules already maps experts -> "ep").
+from llmlb_tpu.models.llama import shard_rules_for  # noqa: E402,F401
+
+
+def param_shardings(cfg: MixtralConfig, mesh: Mesh, rules=None):
+    rules = rules or shard_rules_for(cfg, mesh.shape["tp"])
+    return {
+        name: logical_to_sharding(mesh, rules, *axes)
+        for name, axes in param_logical_axes(cfg).items()
+    }
+
+
+# KV cache layout identical to llama's — reuse.
+from llmlb_tpu.models.llama import init_kv_cache, kv_cache_shardings  # noqa: E402,F401
+
+
+_STACKED = ["wq", "wk", "wv", "wo", "router", "we_gate", "we_up", "we_down",
+            "ln_attn", "ln_mlp"]
+
+
+def _moe_mlp(cfg: MixtralConfig, lp: Params, x: jnp.ndarray, mesh: Mesh | None,
+             *, exact: bool, token_valid: jnp.ndarray | None = None):
+    """x: [B, T, E] -> [B, T, E] through routed experts.
+
+    Two regimes, chosen statically by the caller:
+    - `exact=True` (decode, small prefills): exact dense-combine MoE — every
+      expert runs on every token. Decode is HBM-bound on expert weights either
+      way, and exactness keeps decode logits independent of which other
+      requests share the batch (no capacity drops, no cross-request
+      nondeterminism).
+    - `exact=False` (large prefills): GShard capacity dispatch — routed FLOPs
+      with capacity_factor headroom; over-capacity tokens are dropped
+      (standard MoE serving trade-off, tunable via cfg.capacity_factor).
+      `token_valid` keeps padding out of the capacity contest.
+    """
+    b, t, m = x.shape
+    s = b * t
+    flat = x.reshape(s, m)
+    logits = flat @ lp["router"]
+    if exact:
+        out = moe_dense_exact(
+            flat, logits, lp["we_gate"], lp["we_up"], lp["we_down"],
+            num_selected=cfg.experts_per_token, mesh=mesh,
+        )
+    else:
+        cap = default_capacity(
+            s, cfg.num_experts, cfg.experts_per_token, cfg.capacity_factor
+        )
+        out = moe_dispatch_combine(
+            flat, logits, lp["we_gate"], lp["we_up"], lp["we_down"],
+            num_selected=cfg.experts_per_token, capacity=cap, mesh=mesh,
+            token_valid=None if token_valid is None else token_valid.reshape(s),
+        )
+    return out.reshape(b, t, m)
+
+
+def _moe_mlp_fn(cfg: MixtralConfig, mesh: Mesh | None, exact: bool):
+    """Adapter matching llama's `mlp_fn(lp, h, token_valid)` contract."""
+
+    def fn(lp, h, token_valid):
+        return _moe_mlp(
+            cfg, lp, h, mesh, exact=exact,
+            token_valid=None if exact else token_valid,
+        )
+
+    return fn
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill(params, cfg: MixtralConfig, input_ids, prompt_lens, cache_k, cache_v,
+            mesh: Mesh | None = None):
+    """Prefill B prompts into fresh KV slots. Same contract as llama.prefill."""
+
+    def write_kv(cache, kv, positions):
+        return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
+
+    b, t = input_ids.shape
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
+        stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
+                       slot_ids, cache_k, cache_v, mesh: Mesh | None = None):
+    """Continuous-batching insert path. Same contract as llama.prefill_into_slots."""
+
+    def write_kv(cache, kv, positions):
+        return cache.at[slot_ids[:, None], positions].set(kv)
+
+    b, t = input_ids.shape
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
+        stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_v,
+                mesh: Mesh | None = None):
+    """One decode step across all slots. Same contract as llama.decode_step.
+
+    Decode is ALWAYS exact MoE: capacity drops here would make a request's
+    tokens depend on which other slots share the batch."""
+    return _decode_impl(
+        params, cfg, input_ids, seq_lens, cache_k, cache_v,
+        stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
+    )

@@ -1,0 +1,157 @@
+"""Mixture-of-experts layer: top-k routing with capacity-bounded dispatch.
+
+TPU-first design — the GShard/Mesh-TensorFlow einsum formulation rather than
+gather/scatter token shuffling:
+
+- Static shapes: every tensor's shape depends only on (tokens, experts,
+  capacity), never on routing decisions. Raggedness is expressed by dropping
+  tokens over capacity (standard capacity-factor semantics), so the whole layer
+  jits once and tiles onto the MXU.
+- Expert parallelism rides GSPMD: expert-major tensors are sharding-constrained
+  to the mesh `ep` axis and XLA inserts the dispatch/combine all-to-alls. No
+  hand-written collectives — the idiomatic TPU way (scaling-book recipe).
+- dispatch/combine are one-hot einsums (bf16 matmuls on the MXU), which beats
+  dynamic scatter on TPU for the expert counts this framework targets (8-64).
+
+The reference has no MoE anywhere (it is a gateway; SURVEY.md §2.4 "no EP");
+this op exists for the BASELINE.json config #5 class (Mixtral-8x7B across
+multi-slice v5e) as new TPU-native design.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def top_k_routing(
+    router_logits: jnp.ndarray,  # [S, E] fp32
+    num_selected: int,
+):
+    """Top-k gate: returns (weights [S, k] fp32 normalized, indices [S, k])."""
+    gate_vals, gate_idx = lax.top_k(router_logits, num_selected)
+    # Mixtral normalizes softmax over the selected k (not over all experts).
+    weights = jax.nn.softmax(gate_vals, axis=-1)
+    return weights, gate_idx
+
+
+def moe_dispatch_combine(
+    x: jnp.ndarray,  # [S, M] tokens (S = B*T)
+    router_logits: jnp.ndarray,  # [S, E]
+    w_gate: jnp.ndarray,  # [E, M, F] per-expert gate proj (silu branch)
+    w_up: jnp.ndarray,  # [E, M, F]
+    w_down: jnp.ndarray,  # [E, F, M]
+    *,
+    num_selected: int,
+    capacity: int,
+    mesh: Mesh | None = None,
+    ep_axis: str = "ep",
+    token_valid: jnp.ndarray | None = None,  # [S] bool — False = padding
+) -> jnp.ndarray:
+    """SwiGLU expert MLPs with top-k dispatch. Returns [S, M].
+
+    Tokens beyond an expert's `capacity` are dropped (contribute zero), per
+    standard capacity-factor semantics; callers size capacity as
+    ceil(S * k / E) * capacity_factor. Pass `token_valid` for padded batches:
+    padding tokens would otherwise route like real tokens and burn expert
+    capacity (a mostly-padded bucket could evict every real token).
+    """
+    s, m = x.shape
+    e = w_gate.shape[0]
+    weights, gate_idx = top_k_routing(router_logits.astype(jnp.float32), num_selected)
+
+    # Position of each (token, choice) in its expert's buffer: running count of
+    # prior assignments to the same expert, priority by (choice rank, token id).
+    # one_hot: [S, k, E]
+    one_hot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)
+    if token_valid is not None:
+        one_hot = one_hot * token_valid.astype(jnp.int32)[:, None, None]
+    # flatten choices k-major so choice-0 assignments beat choice-1 on capacity
+    flat = one_hot.transpose(1, 0, 2).reshape(num_selected * s, e)  # [kS, E]
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # position within expert
+    pos = pos_flat.reshape(num_selected, s, e).transpose(1, 0, 2)  # [S, k, E]
+    in_cap = (pos < capacity) & (one_hot == 1)
+
+    # dispatch mask [S, E, C]: token s -> slot pos in expert e (for kept pairs)
+    slot_oh = jax.nn.one_hot(
+        jnp.where(in_cap, pos, capacity), capacity, dtype=x.dtype
+    )  # [S, k, E, C] — overflow rows one_hot to nothing (index == C)
+    dispatch = slot_oh.sum(axis=1)  # [S, E, C]
+    combine = (slot_oh * weights[:, :, None, None].astype(x.dtype)).sum(axis=1)
+
+    expert_in = jnp.einsum(
+        "sec,sm->ecm", dispatch, x, preferred_element_type=jnp.float32
+    ).astype(x.dtype)  # [E, C, M]
+    if mesh is not None and ep_axis in mesh.axis_names:
+        expert_in = lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(ep_axis, None, None))
+        )
+
+    # Per-expert SwiGLU, batched over the (ep-sharded) expert dim.
+    h = jax.nn.silu(
+        jnp.einsum("ecm,emf->ecf", expert_in, w_gate,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    ) * jnp.einsum("ecm,emf->ecf", expert_in, w_up,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "ecf,efm->ecm", h, w_down, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    if mesh is not None and ep_axis in mesh.axis_names:
+        expert_out = lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(ep_axis, None, None))
+        )
+
+    out = jnp.einsum(
+        "sec,ecm->sm", combine, expert_out, preferred_element_type=jnp.float32
+    )
+    return out.astype(x.dtype)
+
+
+def moe_dense_exact(
+    x: jnp.ndarray,  # [S, M]
+    router_logits: jnp.ndarray,  # [S, E]
+    w_gate: jnp.ndarray,  # [E, M, F]
+    w_up: jnp.ndarray,  # [E, M, F]
+    w_down: jnp.ndarray,  # [E, F, M]
+    *,
+    num_selected: int,
+    mesh: Mesh | None = None,
+    ep_axis: str = "ep",
+) -> jnp.ndarray:
+    """Exact top-k MoE: every expert runs on every token, combine masks the
+    rest. E/k × the routed FLOPs — the right trade for *decode*, where S is a
+    small decode batch and the step is bound by streaming expert weights from
+    HBM (which dense and routed both do), not by MXU FLOPs. No tokens are ever
+    dropped, so decode logits are exactly consistent with an unbounded-capacity
+    prefill. Expert dim still shards over `ep`.
+    """
+    weights, gate_idx = top_k_routing(router_logits.astype(jnp.float32), num_selected)
+    e = w_gate.shape[0]
+    # [S, E] combine weights (zero for unselected experts)
+    combine = (jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+               * weights[..., None]).sum(axis=1)
+
+    h = jax.nn.silu(
+        jnp.einsum("sm,emf->esf", x, w_gate,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    ) * jnp.einsum("sm,emf->esf", x, w_up,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "esf,efm->esm", h, w_down, preferred_element_type=jnp.float32
+    )  # [E, S, M] fp32
+    if mesh is not None and ep_axis in mesh.axis_names:
+        expert_out = lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(ep_axis, None, None))
+        )
+    out = jnp.einsum("se,esm->sm", combine, expert_out)
+    return out.astype(x.dtype)
+
+
+def default_capacity(tokens: int, num_experts: int, num_selected: int,
+                     capacity_factor: float = 1.25) -> int:
+    """GShard-style capacity: factor × even-split load, floor 4, MXU-friendly
+    multiple of 4."""
+    cap = int(tokens * num_selected / num_experts * capacity_factor)
+    return max(4, (cap + 3) // 4 * 4)

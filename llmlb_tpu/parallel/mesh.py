@@ -3,8 +3,14 @@
 The engine scales via a named `jax.sharding.Mesh` with axes:
 
     dp — data parallel (replica batches; gradient-free serving means pure request DP)
+    sp — sequence/context parallel (ring attention over sequence chunks for
+         long-context prefill; KV blocks rotate between sp neighbours via
+         `ppermute` — see ops/ring_attention.py)
+    ep — expert parallel (MoE expert dim sharded across devices; GSPMD inserts
+         the dispatch/combine all-to-alls — see ops/moe.py)
     tp — tensor parallel (Megatron-style sharding of attention heads / MLP widths,
-         rides ICI within a slice)
+         rides ICI within a slice; innermost axis so tp collectives are between
+         ICI nearest-neighbours)
 
 The reference gateway has no intra-model parallelism at all (SURVEY.md §2.4) — its
 only parallelism is request-level routing across endpoints. Model parallelism is a
@@ -27,33 +33,45 @@ class MeshConfig:
 
     dp: int = 1
     tp: int = -1
+    sp: int = 1
+    ep: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        dp, tp = self.dp, self.tp
-        if tp == -1 and dp == -1:
+        dp, tp, sp, ep = self.dp, self.tp, self.sp, self.ep
+        unknown = [a for a in (dp, tp, sp, ep) if a == -1]
+        if len(unknown) > 1:
             raise ValueError("at most one mesh axis may be -1")
         if tp == -1:
-            tp = n_devices // dp
+            tp = n_devices // (dp * sp * ep)
         if dp == -1:
-            dp = n_devices // tp
-        if dp * tp != n_devices:
+            dp = n_devices // (tp * sp * ep)
+        if sp == -1:
+            sp = n_devices // (dp * tp * ep)
+        if ep == -1:
+            ep = n_devices // (dp * tp * sp)
+        if dp * tp * sp * ep != n_devices:
             raise ValueError(
-                f"mesh {dp}x{tp} does not cover {n_devices} devices"
+                f"mesh dp={dp} sp={sp} ep={ep} tp={tp} does not cover "
+                f"{n_devices} devices"
             )
-        return MeshConfig(dp=dp, tp=tp)
+        return MeshConfig(dp=dp, tp=tp, sp=sp, ep=ep)
 
 
 def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
-    """Build a (dp, tp) mesh over the given devices (default: all devices).
+    """Build a (dp, sp, ep, tp) mesh over the given devices (default: all).
 
     Device order matters on TPU: `jax.devices()` enumerates in ICI-topology order,
     so adjacent tp ranks are ICI neighbours and tp collectives (the latency-critical
-    ones in tensor-parallel decode) stay on-chip-interconnect rather than DCN.
+    ones in tensor-parallel decode) stay on-chip-interconnect rather than DCN. sp
+    sits outside ep/tp so each ring-attention ppermute hop crosses as few ICI
+    links as possible for the given inner-parallelism degree.
     """
     devices = list(devices if devices is not None else jax.devices())
     config = (config or MeshConfig()).resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(config.dp, config.tp)
-    return Mesh(dev_array, axis_names=("dp", "tp"))
+    dev_array = np.asarray(devices).reshape(
+        config.dp, config.sp, config.ep, config.tp
+    )
+    return Mesh(dev_array, axis_names=("dp", "sp", "ep", "tp"))
 
 
 def default_tp(n_devices: int, num_heads: int, num_kv_heads: int) -> int:

@@ -25,6 +25,8 @@ class ShardingRules:
     kv_heads: str | None = "tp"
     ffn: str | None = "tp"
     vocab: str | None = "tp"
+    # MoE expert dim (ops/moe.py); GSPMD inserts dispatch/combine all-to-alls
+    experts: str | None = "ep"
     # residual-stream model dim: replicated (activations all-reduced after tp matmuls)
     embed: str | None = None
     head_dim: str | None = None

@@ -1,0 +1,94 @@
+"""/v1/embeddings on the engine: OpenAI contract, normalization, batching."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmlb_tpu.engine.server import create_engine_app
+from llmlb_tpu.engine.service import Engine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = Engine.from_preset(
+        "debug-tiny", num_slots=2, slot_capacity=64,
+        prefill_buckets=(16, 32), seed=0,
+    )
+    yield eng
+    eng.shutdown()
+
+
+async def _client(engine) -> TestClient:
+    client = TestClient(TestServer(create_engine_app(engine, owns_engine=False)))
+    await client.start_server()
+    return client
+
+
+def test_embed_service_normalized_and_deterministic(engine):
+    async def run():
+        ids = engine.tokenizer.encode("embedding test input")
+        a = await engine.embed([ids])
+        b = await engine.embed([ids])
+        va = np.asarray(a[0])
+        assert va.shape == (engine.core.cfg.hidden_size,)
+        np.testing.assert_allclose(np.linalg.norm(va), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(va, np.asarray(b[0]), rtol=1e-6)
+    asyncio.run(run())
+
+
+def test_embeddings_route_openai_contract(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            resp = await client.post("/v1/embeddings", json={
+                "model": engine.model_id,
+                "input": ["first text", "second text"],
+            })
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "list"
+            assert len(body["data"]) == 2
+            assert body["data"][0]["object"] == "embedding"
+            assert body["data"][1]["index"] == 1
+            assert body["usage"]["prompt_tokens"] > 0
+            # different texts -> different vectors
+            v0 = np.asarray(body["data"][0]["embedding"])
+            v1 = np.asarray(body["data"][1]["embedding"])
+            assert not np.allclose(v0, v1)
+        finally:
+            await client.close()
+    asyncio.run(run())
+
+
+def test_embeddings_route_token_array_and_errors(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            ids = engine.tokenizer.encode("hello")
+            resp = await client.post("/v1/embeddings", json={"input": ids})
+            assert resp.status == 200
+            body = await resp.json()
+            assert len(body["data"]) == 1
+
+            resp = await client.post("/v1/embeddings", json={})
+            assert resp.status == 400
+            resp = await client.post("/v1/embeddings", json={"input": []})
+            assert resp.status == 400
+        finally:
+            await client.close()
+    asyncio.run(run())
+
+
+def test_models_advertises_embeddings_capability(engine):
+    async def run():
+        client = await _client(engine)
+        try:
+            resp = await client.get("/v1/models")
+            body = await resp.json()
+            caps = body["data"][0]["capabilities"]
+            assert "chat_completion" in caps and "embeddings" in caps
+        finally:
+            await client.close()
+    asyncio.run(run())

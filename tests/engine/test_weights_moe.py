@@ -1,0 +1,81 @@
+"""HF-checkpoint ingestion round-trip for the Mixtral (MoE) layout: write a
+tiny HF-format checkpoint (config.json + safetensors with block_sparse_moe
+names), load it back via engine.weights, and require exact param equality."""
+
+import json
+
+import jax
+import numpy as np
+from safetensors.numpy import save_file
+
+from llmlb_tpu.engine.weights import load_checkpoint, load_config
+from llmlb_tpu.models import mixtral
+
+
+def _save_moe_checkpoint(tmp_path, cfg, params):
+    def t(x):  # safetensors serializes raw buffers: transposes must be materialized
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]),
+        "model.norm.weight": np.asarray(params["ln_final"]),
+        "lm_head.weight": t(params["lm_head"]),
+    }
+    per_layer = {
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "block_sparse_moe.gate.weight": ("router", True),
+        "input_layernorm.weight": ("ln_attn", False),
+        "post_attention_layernorm.weight": ("ln_mlp", False),
+    }
+    for i in range(cfg.num_layers):
+        for hf_name, (ours, transpose) in per_layer.items():
+            w = np.asarray(params[ours][i])
+            tensors[f"model.layers.{i}.{hf_name}"] = t(w) if transpose else w
+        for e in range(cfg.num_experts):
+            base = f"model.layers.{i}.block_sparse_moe.experts.{e}"
+            tensors[f"{base}.w1.weight"] = t(params["we_gate"][i, e])
+            tensors[f"{base}.w3.weight"] = t(params["we_up"][i, e])
+            tensors[f"{base}.w2.weight"] = t(params["we_down"][i, e])
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+
+    hf_config = {
+        "model_type": "mixtral",
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "num_local_experts": cfg.num_experts,
+        "num_experts_per_tok": cfg.experts_per_token,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_eps,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "tie_word_embeddings": False,
+    }
+    (tmp_path / "config.json").write_text(json.dumps(hf_config))
+
+
+def test_moe_checkpoint_roundtrip(tmp_path):
+    from llmlb_tpu.engine.presets import get_preset
+
+    cfg = get_preset("debug-moe-tiny")
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    _save_moe_checkpoint(tmp_path, cfg, params)
+
+    loaded_cfg = load_config(str(tmp_path), dtype=cfg.dtype)
+    assert isinstance(loaded_cfg, mixtral.MixtralConfig)
+    assert loaded_cfg.num_experts == cfg.num_experts
+    assert loaded_cfg.experts_per_token == cfg.experts_per_token
+
+    loaded = load_checkpoint(str(tmp_path), loaded_cfg)
+    assert set(loaded) == set(params)
+    for name in params:
+        np.testing.assert_array_equal(
+            np.asarray(loaded[name], np.float32),
+            np.asarray(params[name], np.float32),
+            err_msg=name,
+        )

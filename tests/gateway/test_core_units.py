@@ -95,6 +95,18 @@ def test_capability_heuristics():
     assert detect_capabilities("llama3:8b") == [Capability.CHAT_COMPLETION]
 
 
+def test_explicit_capabilities_override_heuristics():
+    """The tpu:// engine advertises capabilities in /v1/models entries
+    (engine/server.py list_models); sync must honor them over name guesses."""
+    from llmlb_tpu.gateway.model_sync import capabilities_from_meta
+
+    meta = {"capabilities": ["chat_completion", "embeddings", "bogus"]}
+    assert capabilities_from_meta(meta) == [
+        Capability.CHAT_COMPLETION, Capability.EMBEDDINGS]
+    assert capabilities_from_meta({}) is None
+    assert capabilities_from_meta({"capabilities": ["nonsense"]}) is None
+
+
 def test_registry_roundtrip_and_find(tmp_path):
     db = Database(str(tmp_path / "t.db"))
     reg = EndpointRegistry(db)

@@ -137,6 +137,40 @@ def test_model_sync_ollama_shape():
     asyncio.run(run())
 
 
+def test_model_sync_honors_advertised_capabilities():
+    """A tpu:// engine advertises capabilities in /v1/models (engine/server.py);
+    sync must store them instead of falling back to name heuristics."""
+    async def run():
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from llmlb_tpu.gateway.types import Capability, Endpoint
+
+        async def models(request):
+            return web.json_response({"object": "list", "data": [{
+                "id": "debug-tiny", "object": "model",
+                "capabilities": ["chat_completion", "embeddings"],
+            }]})
+
+        app = web.Application()
+        app.router.add_get("/v1/models", models)
+        server = TestServer(app)
+        await server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            ep = Endpoint(name="tpu", base_url=str(server.make_url("")).rstrip("/"))
+            gw.state.registry.add(ep)
+            await sync_endpoint_models(ep, gw.state.registry, gw.state.http)
+            (model,) = gw.state.registry.models_for(ep.id)
+            # 'debug-tiny' name-heuristics would say CHAT_COMPLETION only
+            assert set(model.capabilities) == {
+                Capability.CHAT_COMPLETION, Capability.EMBEDDINGS}
+        finally:
+            await gw.close()
+            await server.close()
+    asyncio.run(run())
+
+
 def test_tps_balancing_prefers_faster_endpoint():
     """Two endpoints; the faster one (higher measured TPS) wins after probing."""
     async def run():
