@@ -1,0 +1,140 @@
+"""ASR service: WAV bytes → text via the JAX whisper model (models/whisper.py).
+
+Serves /v1/audio/transcriptions on the tpu:// engine. The reference gateway
+re-proxies multipart transcription bodies to external runtimes
+(api/audio.rs:199-370); this service is the in-tree runtime those requests
+land on. Audio handling is dependency-free: stdlib `wave` for RIFF/PCM
+parsing, numpy linear resampling to 16 kHz.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import wave
+
+import jax
+import numpy as np
+
+from llmlb_tpu.models import whisper
+
+
+def decode_wav(data: bytes) -> tuple[np.ndarray, int]:
+    """RIFF/WAV bytes -> (mono float32 in [-1, 1], sample_rate).
+    Accepts PCM16/PCM8/PCM32 via stdlib wave. Raises ValueError (a client
+    error) for anything that is not a decodable WAV."""
+    try:
+        with wave.open(io.BytesIO(data), "rb") as wf:
+            rate = wf.getframerate()
+            n = wf.getnframes()
+            width = wf.getsampwidth()
+            channels = wf.getnchannels()
+            raw = wf.readframes(n)
+    except (wave.Error, EOFError) as e:
+        raise ValueError(f"not a decodable WAV file: {e}") from None
+    if rate <= 0:
+        raise ValueError("WAV reports a non-positive sample rate")
+    if width == 2:
+        audio = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 4:
+        audio = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    elif width == 1:  # unsigned 8-bit
+        audio = (np.frombuffer(raw, "u1").astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if channels > 1:
+        audio = audio.reshape(-1, channels).mean(axis=1)
+    return audio, rate
+
+
+def resample_linear(audio: np.ndarray, src_rate: int, dst_rate: int) -> np.ndarray:
+    if src_rate == dst_rate:
+        return audio
+    n_out = int(round(len(audio) * dst_rate / src_rate))
+    x_out = np.linspace(0.0, len(audio) - 1.0, n_out)
+    return np.interp(x_out, np.arange(len(audio)), audio).astype(np.float32)
+
+
+class AsrEngine:
+    """One loaded whisper model + transcription entry points."""
+
+    def __init__(self, cfg: whisper.WhisperConfig, params, tokenizer=None,
+                 model_id: str = "whisper"):
+        self.cfg = cfg
+        self.params = jax.tree.map(jax.numpy.asarray, params)
+        self.tokenizer = tokenizer  # None => digit-joined token ids (tests)
+        self.model_id = model_id
+        self.total_requests = 0
+
+    # ------------------------------------------------------------ construction
+
+    @classmethod
+    def from_random(cls, cfg: whisper.WhisperConfig | None = None,
+                    model_id: str = "whisper-random", seed: int = 0):
+        cfg = cfg or whisper.WhisperConfig(
+            vocab_size=1024, n_mels=80, d_model=64, encoder_layers=2,
+            decoder_layers=2, num_heads=4, n_audio_ctx=200, n_text_ctx=64,
+            sot_token=1000, eot_token=1001, transcribe_token=1002,
+            no_timestamps_token=1003, english_token=1004,
+        )
+        params = whisper.init_params(cfg, jax.random.PRNGKey(seed))
+        return cls(cfg, params, model_id=model_id)
+
+    @classmethod
+    def from_checkpoint(cls, model_dir: str, model_id: str | None = None):
+        """HF whisper checkpoint directory (config.json + safetensors +
+        tokenizer files)."""
+        from llmlb_tpu.engine.weights import _safetensors_getter
+
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = whisper.WhisperConfig.from_hf_config(json.load(f))
+        params = whisper.convert_hf_tensors(cfg, _safetensors_getter(model_dir))
+        tokenizer = None
+        try:
+            from transformers import WhisperTokenizer
+
+            tokenizer = WhisperTokenizer.from_pretrained(model_dir)
+        except Exception:
+            pass
+        return cls(cfg, params, tokenizer,
+                   model_id or os.path.basename(model_dir.rstrip("/")))
+
+    # --------------------------------------------------------------- serving
+
+    def _mel_for(self, audio: np.ndarray) -> np.ndarray:
+        """Frame audio to mel with pow2-bucketed frame counts (bounded compile
+        count), capped at the model's audio context."""
+        mel = np.asarray(whisper.log_mel_spectrogram(
+            jax.numpy.asarray(audio), self.cfg.n_mels
+        ))
+        max_frames = self.cfg.n_audio_ctx * 2
+        frames = mel.shape[0]
+        bucket = 16
+        while bucket < frames:
+            bucket *= 2
+        bucket = min(bucket, max_frames)
+        out = np.zeros((bucket, self.cfg.n_mels), np.float32)
+        out[: min(frames, bucket)] = mel[:bucket]
+        return out
+
+    def transcribe_audio(self, audio: np.ndarray, sample_rate: int,
+                         max_tokens: int = 128) -> str:
+        """Mono float32 audio at any rate -> transcript text."""
+        self.total_requests += 1
+        audio = resample_linear(audio, sample_rate, whisper.SAMPLE_RATE)
+        max_samples = self.cfg.n_audio_ctx * 2 * whisper.HOP_LENGTH
+        audio = audio[:max_samples]
+        if len(audio) < whisper.N_FFT:
+            audio = np.pad(audio, (0, whisper.N_FFT - len(audio)))
+        mel = self._mel_for(audio)
+        tokens = whisper.greedy_transcribe_tokens(
+            self.params, self.cfg, jax.numpy.asarray(mel), max_tokens
+        )
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(tokens, skip_special_tokens=True)
+        return " ".join(str(t) for t in tokens)
+
+    def transcribe_wav_bytes(self, data: bytes, max_tokens: int = 128) -> str:
+        audio, rate = decode_wav(data)
+        return self.transcribe_audio(audio, rate, max_tokens)

@@ -1,0 +1,107 @@
+"""Image generation service: prompt → PNG via the JAX diffusion model.
+
+Serves /v1/images/generations on the tpu:// engine (reference proxies these
+to capability-advertising endpoints, api/images.rs:184). PNG encoding is
+stdlib-only (zlib + struct).
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmlb_tpu.models import diffusion
+
+
+def encode_png(rgb: np.ndarray) -> bytes:
+    """[H, W, 3] uint8 -> PNG bytes (8-bit truecolor, no filtering)."""
+    h, w, _ = rgb.shape
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        return (struct.pack(">I", len(payload)) + tag + payload
+                + struct.pack(">I", zlib.crc32(tag + payload) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)
+    raw = b"".join(b"\x00" + rgb[y].tobytes() for y in range(h))
+    return (b"\x89PNG\r\n\x1a\n"
+            + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw, 6))
+            + chunk(b"IEND", b""))
+
+
+class ImageEngine:
+    """One loaded diffusion model + generation entry points."""
+
+    def __init__(self, cfg: diffusion.DiffusionConfig, params,
+                 model_id: str = "diffusion", sample_steps: int = 20):
+        self.cfg = cfg
+        self.params = jax.tree.map(
+            lambda x: None if x is None else jnp.asarray(x), params,
+            is_leaf=lambda x: x is None,
+        )
+        self.model_id = model_id
+        self.sample_steps = sample_steps
+        self.total_requests = 0
+        # itertools.count.__next__ is atomic under the GIL — concurrent
+        # requests on different executor threads each get a distinct seed
+        import itertools
+
+        self._seed_counter = itertools.count(
+            int(np.random.SeedSequence().entropy % (2**30))
+        )
+
+    @classmethod
+    def from_random(cls, cfg: diffusion.DiffusionConfig | None = None,
+                    model_id: str = "diffusion-random", seed: int = 0,
+                    sample_steps: int = 8):
+        cfg = cfg or diffusion.DiffusionConfig(
+            img_size=16, base_ch=16, ch_mults=(1, 2), text_dim=32,
+            max_text_len=64,
+        )
+        params = diffusion.init_params(cfg, jax.random.PRNGKey(seed))
+        return cls(cfg, params, model_id=model_id, sample_steps=sample_steps)
+
+    @classmethod
+    def from_checkpoint(cls, model_dir: str, model_id: str | None = None,
+                        sample_steps: int = 20):
+        cfg, params = diffusion.load_checkpoint(model_dir)
+        import os
+
+        return cls(cfg, params,
+                   model_id or os.path.basename(model_dir.rstrip("/")),
+                   sample_steps)
+
+    def generate(self, prompt: str, n: int = 1, seed: int | None = None
+                 ) -> list[bytes]:
+        """Prompt -> n PNG images."""
+        if not prompt:
+            raise ValueError("'prompt' is required")
+        if not 1 <= n <= 10:
+            raise ValueError("'n' must be between 1 and 10")
+        self.total_requests += 1
+
+        data = prompt.encode("utf-8", errors="replace")[: self.cfg.max_text_len]
+        ln = len(data)
+        ids = np.zeros((1, self.cfg.max_text_len), np.int32)
+        ids[0, :ln] = np.frombuffer(data, np.uint8) + 1  # 0 is pad
+        if seed is None:
+            seed = next(self._seed_counter) % (2**31)
+        imgs = diffusion.ddim_sample(
+            self.params, self.cfg, jax.random.PRNGKey(seed),
+            jnp.asarray(ids), jnp.asarray([ln], np.int32),
+            n, n_steps=self.sample_steps,
+        )
+        out = []
+        for i in range(n):
+            arr = np.asarray((imgs[i] + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+            out.append(encode_png(arr))
+        return out
+
+    def generate_b64(self, prompt: str, n: int = 1, seed: int | None = None
+                     ) -> list[str]:
+        return [base64.b64encode(p).decode() for p in self.generate(prompt, n, seed)]

@@ -87,8 +87,11 @@ async def _sse_send(resp: web.StreamResponse, payload: dict | str) -> None:
 
 
 class EngineAPI:
-    def __init__(self, engine: Engine):
+    def __init__(self, engine: Engine, *, asr=None, tts=None, image=None):
         self.engine = engine
+        self.asr = asr  # engine.asr.AsrEngine | None
+        self.tts = tts  # engine.tts.TtsEngine | None
+        self.image = image  # engine.image.ImageEngine | None
 
     # ------------------------------------------------------------- inventory
 
@@ -96,22 +99,93 @@ class EngineAPI:
         caps = ["chat_completion"]
         if self.engine.supports_embeddings():
             caps.append("embeddings")
-        return web.json_response(
-            {
-                "object": "list",
-                "data": [
-                    {
-                        "id": self.engine.model_id,
-                        "object": "model",
-                        "created": 0,
-                        "owned_by": "llmlb_tpu",
-                        # advertised so the gateway's model sync can assign
-                        # capabilities without name heuristics
-                        "capabilities": caps,
-                    }
-                ],
+
+        def entry(model_id: str, caps: list[str]) -> dict:
+            return {
+                "id": model_id,
+                "object": "model",
+                "created": 0,
+                "owned_by": "llmlb_tpu",
+                # advertised so the gateway's model sync can assign
+                # capabilities without name heuristics
+                "capabilities": caps,
             }
-        )
+
+        data = [entry(self.engine.model_id, caps)]
+        if self.asr is not None:
+            data.append(entry(self.asr.model_id, ["audio_transcription"]))
+        if self.tts is not None:
+            data.append(entry(self.tts.model_id, ["audio_speech"]))
+        if self.image is not None:
+            data.append(entry(self.image.model_id, ["image_generation"]))
+        return web.json_response({"object": "list", "data": data})
+
+    # ------------------------------------------------------------ multimodal
+
+    async def audio_transcriptions(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/audio/transcriptions: multipart form with `file` (WAV)."""
+        if self.asr is None:
+            return _error(404, "no transcription model is loaded on this engine")
+        if not (request.content_type or "").startswith("multipart/"):
+            return _error(400, "multipart/form-data body required")
+        file_bytes = None
+        async for part in await request.multipart():
+            if part.name == "file":
+                file_bytes = await part.read(decode=False)
+            else:
+                await part.read(decode=False)  # drain model/language/etc.
+        if not file_bytes:
+            return _error(400, "'file' part is required")
+        loop = asyncio.get_running_loop()
+        try:
+            text = await loop.run_in_executor(
+                None, self.asr.transcribe_wav_bytes, file_bytes
+            )
+        except (ValueError, EOFError) as e:
+            return _error(400, f"could not decode audio: {e}")
+        return web.json_response({"text": text})
+
+    async def audio_speech(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/audio/speech: JSON {input, voice, speed} -> WAV bytes."""
+        if self.tts is None:
+            return _error(404, "no speech model is loaded on this engine")
+        body = await request.json()
+        text = body.get("input")
+        if not isinstance(text, str) or not text:
+            return _error(400, "'input' is required")
+        voice = str(body.get("voice", "alloy"))
+        speed = float(body.get("speed", 1.0))
+        loop = asyncio.get_running_loop()
+        try:
+            wav = await loop.run_in_executor(
+                None, self.tts.synthesize, text, voice, speed
+            )
+        except ValueError as e:
+            return _error(400, str(e))
+        return web.Response(body=wav, content_type="audio/wav")
+
+    async def images_generations(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/images/generations: JSON {prompt, n} -> b64 PNGs."""
+        if self.image is None:
+            return _error(404, "no image model is loaded on this engine")
+        body = await request.json()
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            return _error(400, "'prompt' is required")
+        n = body.get("n", 1)
+        if not isinstance(n, int) or not 1 <= n <= 10:
+            return _error(400, "'n' must be between 1 and 10")
+        loop = asyncio.get_running_loop()
+        try:
+            images = await loop.run_in_executor(
+                None, self.image.generate_b64, prompt, n
+            )
+        except ValueError as e:
+            return _error(400, str(e))
+        return web.json_response({
+            "created": int(time.time()),
+            "data": [{"b64_json": b} for b in images],
+        })
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """OpenAI /v1/embeddings: input may be a string, list of strings, or
@@ -497,14 +571,18 @@ async def error_middleware(request: web.Request, handler):
         return _error(500, "internal server error", "server_error")
 
 
-def create_engine_app(engine: Engine, *, owns_engine: bool = True) -> web.Application:
+def create_engine_app(engine: Engine, *, owns_engine: bool = True,
+                      asr=None, tts=None, image=None) -> web.Application:
     app = web.Application(client_max_size=MAX_BODY_BYTES, middlewares=[error_middleware])
-    api = EngineAPI(engine)
+    api = EngineAPI(engine, asr=asr, tts=tts, image=image)
     app.router.add_get("/v1/models", api.list_models)
     app.router.add_post("/v1/chat/completions", api.chat_completions)
     app.router.add_post("/v1/completions", api.completions)
     app.router.add_post("/v1/responses", api.responses)
     app.router.add_post("/v1/embeddings", api.embeddings)
+    app.router.add_post("/v1/audio/transcriptions", api.audio_transcriptions)
+    app.router.add_post("/v1/audio/speech", api.audio_speech)
+    app.router.add_post("/v1/images/generations", api.images_generations)
     app.router.add_get("/api/health", api.health)
     app.router.add_get("/api/system", api.system)
 
@@ -525,6 +603,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--port", type=int, default=8100)
     parser.add_argument("--num-slots", type=int, default=8)
     parser.add_argument("--slot-capacity", type=int, default=512)
+    # modality services (checkpoint dir, or "random" for test weights)
+    parser.add_argument("--asr", default=None,
+                        help="whisper checkpoint dir or 'random'")
+    parser.add_argument("--tts", default=None,
+                        help="TTS checkpoint dir or 'random'")
+    parser.add_argument("--image", default=None,
+                        help="diffusion checkpoint dir or 'random'")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -541,7 +626,28 @@ def main(argv: list[str] | None = None) -> None:
             args.preset, model_id=args.model_id,
             num_slots=args.num_slots, slot_capacity=args.slot_capacity,
         )
-    web.run_app(create_engine_app(engine), host=args.host, port=args.port)
+
+    asr = tts = image = None
+    if args.asr:
+        from llmlb_tpu.engine.asr import AsrEngine
+
+        asr = (AsrEngine.from_random() if args.asr == "random"
+               else AsrEngine.from_checkpoint(args.asr))
+    if args.tts:
+        from llmlb_tpu.engine.tts import TtsEngine
+
+        tts = (TtsEngine.from_random() if args.tts == "random"
+               else TtsEngine.from_checkpoint(args.tts))
+    if args.image:
+        from llmlb_tpu.engine.image import ImageEngine
+
+        image = (ImageEngine.from_random() if args.image == "random"
+                 else ImageEngine.from_checkpoint(args.image))
+
+    web.run_app(
+        create_engine_app(engine, asr=asr, tts=tts, image=image),
+        host=args.host, port=args.port,
+    )
 
 
 if __name__ == "__main__":

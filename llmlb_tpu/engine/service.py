@@ -254,13 +254,19 @@ class Engine:
                 f"input of {longest} tokens exceeds the model context "
                 f"({self.core.cfg.max_position_embeddings})"
             )
+        import numpy as np
+
         vocab = self.core.cfg.vocab_size
-        for toks in batch_ids:
-            for t in toks:
-                if not 0 <= t < vocab:
-                    raise ValueError(
-                        f"token id {t} out of range for vocab size {vocab}"
-                    )
+        # vectorized range check — this runs on the event loop, so it must
+        # stay O(total tokens) in numpy, not a Python per-token loop
+        flat = np.fromiter(
+            (t for toks in batch_ids for t in toks), np.int64
+        )
+        if flat.size and (flat.min() < 0 or flat.max() >= vocab):
+            bad = int(flat[(flat < 0) | (flat >= vocab)][0])
+            raise ValueError(
+                f"token id {bad} out of range for vocab size {vocab}"
+            )
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._executor, self._embed_sync, batch_ids

@@ -1,0 +1,102 @@
+"""TTS service: text → WAV bytes via the JAX TTS model (models/tts.py).
+
+Serves /v1/audio/speech on the tpu:// engine (reference proxies these to
+endpoints advertising the AudioSpeech capability, api/audio.rs:377). WAV
+encoding is stdlib `wave`; no external audio dependencies.
+"""
+
+from __future__ import annotations
+
+import io
+import wave
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmlb_tpu.models import tts
+from llmlb_tpu.models.whisper import SAMPLE_RATE
+
+
+def encode_wav(audio: np.ndarray, sample_rate: int = SAMPLE_RATE) -> bytes:
+    """Mono float32 [-1, 1] -> RIFF/WAV PCM16 bytes."""
+    pcm = np.clip(audio, -1.0, 1.0)
+    pcm16 = (pcm * 32767.0).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as wf:
+        wf.setnchannels(1)
+        wf.setsampwidth(2)
+        wf.setframerate(sample_rate)
+        wf.writeframes(pcm16.tobytes())
+    return buf.getvalue()
+
+
+class TtsEngine:
+    """One loaded TTS model + synthesis entry points."""
+
+    MAX_INPUT_CHARS = 4096  # matches OpenAI's /v1/audio/speech input cap
+
+    def __init__(self, cfg: tts.TtsConfig, params, model_id: str = "tts"):
+        self.cfg = cfg
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.model_id = model_id
+        self.total_requests = 0
+
+    @classmethod
+    def from_random(cls, cfg: tts.TtsConfig | None = None,
+                    model_id: str = "tts-random", seed: int = 0):
+        cfg = cfg or tts.TtsConfig(
+            d_model=64, encoder_layers=2, decoder_layers=2, num_heads=4,
+            upsample=4, max_text_len=128,
+        )
+        return cls(cfg, tts.init_params(cfg, jax.random.PRNGKey(seed)),
+                   model_id=model_id)
+
+    @classmethod
+    def from_checkpoint(cls, model_dir: str, model_id: str | None = None):
+        cfg, params = tts.load_checkpoint(model_dir)
+        import os
+
+        return cls(cfg, params,
+                   model_id or os.path.basename(model_dir.rstrip("/")))
+
+    def synthesize(self, text: str, voice: str = "alloy",
+                   speed: float = 1.0) -> bytes:
+        """Text -> WAV bytes. `speed` resamples the output (0.25-4.0)."""
+        if not text:
+            raise ValueError("'input' text must not be empty")
+        if len(text) > self.MAX_INPUT_CHARS:
+            raise ValueError(
+                f"input too long ({len(text)} chars; max {self.MAX_INPUT_CHARS})"
+            )
+        if not 0.25 <= speed <= 4.0:
+            raise ValueError("'speed' must be between 0.25 and 4.0")
+        self.total_requests += 1
+
+        data = text.encode("utf-8", errors="replace")[: self.cfg.max_text_len]
+        n = len(data)
+        bucket = 16
+        while bucket < n:
+            bucket *= 2
+        bucket = min(bucket, self.cfg.max_text_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = np.frombuffer(data, np.uint8)
+        mel = tts.synthesize_mel(
+            self.params, self.cfg, jnp.asarray(ids),
+            jnp.asarray([n], np.int32),
+            jnp.asarray([tts.voice_id(voice)], np.int32),
+        )[0]
+        # vocode at the bucketed length (griffin_lim is jitted per shape —
+        # trimming mel first would recompile for every distinct text length),
+        # then trim the synthesized audio to the real frame count
+        audio = np.asarray(tts.griffin_lim(mel))
+        from llmlb_tpu.models.whisper import HOP_LENGTH
+
+        audio = audio[: n * self.cfg.upsample * HOP_LENGTH]
+        if speed != 1.0:
+            n_out = max(1, int(round(len(audio) / speed)))
+            audio = np.interp(
+                np.linspace(0, len(audio) - 1, n_out),
+                np.arange(len(audio)), audio,
+            ).astype(np.float32)
+        return encode_wav(audio)

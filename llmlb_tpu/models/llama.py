@@ -259,6 +259,21 @@ def _default_mlp_fn(lp: Params, h: jnp.ndarray, token_valid) -> jnp.ndarray:
     return _mlp(lp, h)
 
 
+def _write_kv_fresh(cache, kv, positions):
+    """KV write for prefill into fresh per-request slots (rows 0..B)."""
+    return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
+
+
+def make_write_kv_slots(slot_ids: jnp.ndarray):
+    """KV write that scatters prompts into rows `slot_ids` of the engine's
+    live slot cache — the continuous-batching insert path."""
+
+    def write_kv(cache, kv, positions):
+        return cache.at[slot_ids[:, None], positions].set(kv)
+
+    return write_kv
+
+
 def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
                   *, stacked_names=None, mlp_fn=_default_mlp_fn):
     """Shared prefill body for every model family.
@@ -343,12 +358,8 @@ def prefill(
 ):
     """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
     cache_k, cache_v)."""
-
-    def write_kv(cache, kv, positions):
-        return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
-
     return _prefill_impl(
-        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, _write_kv_fresh
     )
 
 
@@ -368,12 +379,9 @@ def prefill_into_slots(
     slot cache — the continuous-batching insert path (new requests land in freed
     slots while other slots keep decoding). Returns (last_logits [B, V] fp32,
     cache_k, cache_v)."""
-
-    def write_kv(cache, kv, positions):
-        return cache.at[slot_ids[:, None], positions].set(kv)
-
     return _prefill_impl(
-        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v,
+        make_write_kv_slots(slot_ids),
     )
 
 

@@ -19,10 +19,15 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import Mesh
 
-from llmlb_tpu.models.llama import LlamaConfig, _decode_impl, _prefill_impl
+from llmlb_tpu.models.llama import (
+    LlamaConfig,
+    _decode_impl,
+    _prefill_impl,
+    _write_kv_fresh,
+    make_write_kv_slots,
+)
 from llmlb_tpu.ops.moe import default_capacity, moe_dense_exact, moe_dispatch_combine
 from llmlb_tpu.parallel.sharding import logical_to_sharding
 
@@ -170,13 +175,9 @@ def _moe_mlp_fn(cfg: MixtralConfig, mesh: Mesh | None, exact: bool):
 def prefill(params, cfg: MixtralConfig, input_ids, prompt_lens, cache_k, cache_v,
             mesh: Mesh | None = None):
     """Prefill B prompts into fresh KV slots. Same contract as llama.prefill."""
-
-    def write_kv(cache, kv, positions):
-        return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
-
     b, t = input_ids.shape
     return _prefill_impl(
-        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, _write_kv_fresh,
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
     )
@@ -187,13 +188,10 @@ def prefill(params, cfg: MixtralConfig, input_ids, prompt_lens, cache_k, cache_v
 def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
                        slot_ids, cache_k, cache_v, mesh: Mesh | None = None):
     """Continuous-batching insert path. Same contract as llama.prefill_into_slots."""
-
-    def write_kv(cache, kv, positions):
-        return cache.at[slot_ids[:, None], positions].set(kv)
-
     b, t = input_ids.shape
     return _prefill_impl(
-        params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v,
+        make_write_kv_slots(slot_ids),
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
     )

@@ -1,0 +1,270 @@
+"""Text-to-speech: non-autoregressive byte→mel transformer + Griffin-Lim.
+
+Backs /v1/audio/speech on the tpu:// engine. The reference ships only a
+PyTorch TTS proof-of-concept run out-of-process (poc/vibevoice-pytorch/run.py,
+SURVEY.md §2.3) and proxies speech requests to whatever endpoint advertises
+the capability (api/audio.rs:377); this is the in-tree TPU-native equivalent:
+
+- FastSpeech-style parallel synthesis: byte embedding → pre-LN transformer
+  encoder → fixed-ratio length regulator → decoder stack → linear mel head.
+  Everything static-shape and jitted; one forward per utterance (no
+  autoregressive loop — synthesis latency is one MXU pass).
+- Griffin-Lim vocoder in JAX: mel → linear magnitude via the mel filterbank
+  pseudo-inverse, then `n_iter` rounds of ISTFT/STFT phase refinement under
+  `lax.scan`. No external audio dependencies.
+- Voice conditioning: a learned per-voice embedding table added to the
+  encoder input ("alloy", "echo", ... map to rows; unknown voices fall back
+  to row 0).
+
+Weights are framework-native (our pytree in a safetensors file) — there is no
+canonical public HF arch for this compact design; save/load round-trips via
+save_checkpoint/load_checkpoint below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from llmlb_tpu.models.whisper import (
+    HOP_LENGTH,
+    N_FFT,
+    SAMPLE_RATE,
+    _layer_norm,
+    _mha,
+    _sinusoids,
+    mel_filterbank,
+)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TtsConfig:
+    vocab_size: int = 256  # bytes
+    d_model: int = 256
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    num_heads: int = 4
+    n_mels: int = 80
+    upsample: int = 8  # mel frames per input byte (fixed-ratio length regulator)
+    max_text_len: int = 512
+    num_voices: int = 8
+    dtype: Any = jnp.float32
+
+
+VOICES = ("alloy", "echo", "fable", "onyx", "nova", "shimmer")
+
+
+def voice_id(name: str) -> int:
+    try:
+        return 1 + VOICES.index(name.lower())
+    except ValueError:
+        return 0
+
+
+def init_params(cfg: TtsConfig, key: jax.Array) -> Params:
+    d = cfg.d_model
+    ks = iter(jax.random.split(key, 24))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(ks), shape, jnp.float32)
+                * fan_in**-0.5).astype(cfg.dtype)
+
+    def attn_block(layers):
+        return {
+            "wq": w((layers, d, d), d), "bq": jnp.zeros((layers, d), cfg.dtype),
+            "wk": w((layers, d, d), d),
+            "wv": w((layers, d, d), d), "bv": jnp.zeros((layers, d), cfg.dtype),
+            "wo": w((layers, d, d), d), "bo": jnp.zeros((layers, d), cfg.dtype),
+        }
+
+    def mlp_block(layers):
+        return {
+            "w1": w((layers, d, 4 * d), d),
+            "b1": jnp.zeros((layers, 4 * d), cfg.dtype),
+            "w2": w((layers, 4 * d, d), 4 * d),
+            "b2": jnp.zeros((layers, d), cfg.dtype),
+        }
+
+    def ln(layers):
+        return (jnp.ones((layers, d), cfg.dtype), jnp.zeros((layers, d), cfg.dtype))
+
+    el, dl = cfg.encoder_layers, cfg.decoder_layers
+    max_frames = cfg.max_text_len * cfg.upsample
+    return {
+        "byte_embed": w((cfg.vocab_size, d), d),
+        "voice_embed": w((cfg.num_voices, d), d),
+        "enc_pos": jnp.asarray(_sinusoids(cfg.max_text_len, d), cfg.dtype),
+        "dec_pos": jnp.asarray(_sinusoids(max_frames, d), cfg.dtype),
+        "enc_attn": attn_block(el), "enc_mlp": mlp_block(el),
+        "enc_ln1": ln(el), "enc_ln2": ln(el),
+        "dec_attn": attn_block(dl), "dec_mlp": mlp_block(dl),
+        "dec_ln1": ln(dl), "dec_ln2": ln(dl),
+        "lnf": (jnp.ones((d,), cfg.dtype), jnp.zeros((d,), cfg.dtype)),
+        "mel_head_w": w((d, cfg.n_mels), d),
+        "mel_head_b": jnp.zeros((cfg.n_mels,), cfg.dtype),
+    }
+
+
+def _transformer(cfg: TtsConfig, x, attn, mlp, ln1, ln2, n_layers, mask=None):
+    def layer(carry, i):
+        at = jax.tree.map(lambda a: a[i], attn)
+        ml = jax.tree.map(lambda a: a[i], mlp)
+        l1 = jax.tree.map(lambda a: a[i], ln1)
+        l2 = jax.tree.map(lambda a: a[i], ln2)
+        h = _layer_norm(carry, l1)
+        carry = carry + _mha(at, h, h, cfg.num_heads, mask=mask)
+        h = _layer_norm(carry, l2)
+        carry = carry + (jax.nn.gelu(h @ ml["w1"] + ml["b1"], approximate=False)
+                         @ ml["w2"] + ml["b2"])
+        return carry, None
+
+    x, _ = lax.scan(layer, x, jnp.arange(n_layers))
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def synthesize_mel(params: Params, cfg: TtsConfig,
+                   byte_ids: jnp.ndarray,  # [B, T] int32, right-padded
+                   text_lens: jnp.ndarray,  # [B] int32
+                   voice_ids: jnp.ndarray,  # [B] int32
+                   ) -> jnp.ndarray:
+    """[B, T*upsample, n_mels] mel frames (frames past text_lens*upsample are
+    synthesized from padding and should be trimmed by the caller)."""
+    b, t = byte_ids.shape
+    x = params["byte_embed"][byte_ids] + params["enc_pos"][None, :t]
+    x = x + params["voice_embed"][voice_ids][:, None, :]
+    # mask attention to valid text positions
+    valid = jnp.arange(t)[None, :] < text_lens[:, None]  # [B, T]
+    mask = valid[:, None, None, :]  # [B, 1, 1, T]
+    x = _transformer(cfg, x, params["enc_attn"], params["enc_mlp"],
+                     params["enc_ln1"], params["enc_ln2"],
+                     cfg.encoder_layers, mask=mask)
+    # fixed-ratio length regulator: repeat each byte state `upsample` times
+    frames = jnp.repeat(x, cfg.upsample, axis=1)
+    frames = frames + params["dec_pos"][None, : frames.shape[1]]
+    fvalid = jnp.repeat(valid, cfg.upsample, axis=1)
+    fmask = fvalid[:, None, None, :]
+    frames = _transformer(cfg, frames, params["dec_attn"], params["dec_mlp"],
+                          params["dec_ln1"], params["dec_ln2"],
+                          cfg.decoder_layers, mask=fmask)
+    frames = _layer_norm(frames, params["lnf"])
+    return frames @ params["mel_head_w"] + params["mel_head_b"]
+
+
+# ---------------------------------------------------------------------------
+# Griffin-Lim vocoder
+# ---------------------------------------------------------------------------
+
+def _stft(audio: jnp.ndarray) -> jnp.ndarray:
+    window = jnp.asarray(np.hanning(N_FFT + 1)[:-1].astype(np.float32))
+    n_frames = 1 + (audio.shape[0] - N_FFT) // HOP_LENGTH
+    idx = (jnp.arange(n_frames)[:, None] * HOP_LENGTH
+           + jnp.arange(N_FFT)[None, :])
+    return jnp.fft.rfft(audio[idx] * window[None, :], axis=-1)
+
+
+def _istft(spec: jnp.ndarray, n_samples: int) -> jnp.ndarray:
+    window = jnp.asarray(np.hanning(N_FFT + 1)[:-1].astype(np.float32))
+    frames = jnp.fft.irfft(spec, n=N_FFT, axis=-1) * window[None, :]
+    n_frames = spec.shape[0]
+    audio = jnp.zeros((n_samples,), jnp.float32)
+    norm = jnp.zeros((n_samples,), jnp.float32)
+    starts = jnp.arange(n_frames) * HOP_LENGTH
+    idx = starts[:, None] + jnp.arange(N_FFT)[None, :]
+    audio = audio.at[idx.reshape(-1)].add(frames.reshape(-1))
+    norm = norm.at[idx.reshape(-1)].add((window**2)[None, :].repeat(
+        n_frames, 0).reshape(-1))
+    return audio / jnp.maximum(norm, 1e-8)
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def griffin_lim(mel: jnp.ndarray, n_iter: int = 24,
+                key: jax.Array | None = None) -> jnp.ndarray:
+    """[frames, n_mels] log-mel-ish magnitudes -> [samples] float32 audio."""
+    # mel -> linear magnitude via filterbank pseudo-inverse ([bins, n_mels])
+    pinv = jnp.asarray(np.linalg.pinv(mel_filterbank(mel.shape[1])))
+    mag = jnp.maximum(jnp.exp(mel) @ pinv.T, 0.0)  # [frames, bins]
+    n_samples = (mag.shape[0] - 1) * HOP_LENGTH + N_FFT
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    phase = jax.random.uniform(key, mag.shape, jnp.float32, 0, 2 * np.pi)
+    spec = mag * jnp.exp(1j * phase)
+
+    def step(spec, _):
+        audio = _istft(spec, n_samples)
+        re = _stft(audio)
+        re = re[: mag.shape[0]]
+        spec = mag * jnp.exp(1j * jnp.angle(re))
+        return spec, None
+
+    spec, _ = lax.scan(step, spec, None, length=n_iter)
+    audio = _istft(spec, n_samples)
+    peak = jnp.max(jnp.abs(audio))
+    return audio / jnp.maximum(peak, 1e-6) * 0.95
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round-trip (framework-native safetensors of the flat pytree)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(path: str, cfg: TtsConfig, params: Params) -> None:
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    flat = {}
+
+    def add(prefix, leaf):
+        if isinstance(leaf, dict):
+            for k, v in leaf.items():
+                add(f"{prefix}.{k}" if prefix else k, v)
+        elif isinstance(leaf, tuple):
+            for i, v in enumerate(leaf):
+                add(f"{prefix}.{i}", v)
+        else:
+            flat[prefix] = np.asarray(leaf)
+
+    add("", params)
+    os.makedirs(path, exist_ok=True)
+    save_file(flat, os.path.join(path, "model.safetensors"))
+    meta = {k: v for k, v in dataclasses.asdict(cfg).items() if k != "dtype"}
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({"model_type": "llmlb_tpu_tts", **meta}, f)
+
+
+def load_checkpoint(path: str) -> tuple[TtsConfig, Params]:
+    import json
+    import os
+
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(path, "config.json")) as f:
+        meta = json.load(f)
+    meta.pop("model_type", None)
+    cfg = TtsConfig(**meta)
+    flat = load_file(os.path.join(path, "model.safetensors"))
+    params: Params = {}
+    for key, value in flat.items():
+        parts = key.split(".")
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(value)
+
+    def fix(node):
+        if isinstance(node, dict):
+            if set(node) == {"0", "1"}:
+                return (fix(node["0"]), fix(node["1"]))
+            return {k: fix(v) for k, v in node.items()}
+        return node
+
+    return cfg, fix(params)

@@ -1,0 +1,436 @@
+"""Whisper-family ASR (encoder-decoder transformer) — functional JAX.
+
+Backs /v1/audio/transcriptions on the tpu:// engine. The reference only
+*proxies* transcription requests to external runtimes (api/audio.rs:199-370
+multipart re-proxy, capability selection :160-183); the model itself is new
+TPU-native design:
+
+- Log-mel frontend as jittable JAX ops (framed STFT via conv-style gather +
+  rFFT, slaney mel filterbank precomputed in numpy) — the whole
+  audio→text path stays on device.
+- Encoder: two gelu convs (stride 1, 2) + fixed sinusoidal positions +
+  pre-LN transformer stack, scanned over stacked layer params (compile once
+  for any depth, same trick as models/llama.py).
+- Decoder: learned positions, causal self-attention over a static-capacity
+  KV cache, cross-attention against precomputed encoder K/V — serving-shaped
+  `decode_step` with fully static shapes.
+- Greedy transcription loop on host, one jitted step per token (token count
+  per utterance is small; batching across requests happens at the service
+  layer).
+
+HF checkpoint layout (openai/whisper-*) maps via convert_hf_tensors below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Params = dict[str, Any]
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP_LENGTH = 160
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    vocab_size: int = 51865
+    n_mels: int = 80
+    d_model: int = 384  # whisper-tiny
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    num_heads: int = 6
+    n_audio_ctx: int = 1500  # 30 s of audio after conv stride 2
+    n_text_ctx: int = 448
+    # special tokens (multilingual vocab defaults)
+    sot_token: int = 50258
+    eot_token: int = 50257
+    transcribe_token: int = 50359
+    no_timestamps_token: int = 50363
+    english_token: int = 50259
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, dtype=jnp.float32) -> "WhisperConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            n_mels=hf.get("num_mel_bins", 80),
+            d_model=hf["d_model"],
+            encoder_layers=hf["encoder_layers"],
+            decoder_layers=hf["decoder_layers"],
+            num_heads=hf["encoder_attention_heads"],
+            n_audio_ctx=hf.get("max_source_positions", 1500),
+            n_text_ctx=hf.get("max_target_positions", 448),
+            dtype=dtype,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Log-mel frontend
+# ---------------------------------------------------------------------------
+
+def mel_filterbank(n_mels: int = 80, n_fft: int = N_FFT,
+                   sample_rate: int = SAMPLE_RATE) -> np.ndarray:
+    """Slaney-normalized triangular mel filters [n_mels, n_fft//2 + 1]
+    (matches librosa.filters.mel defaults, which whisper's frontend uses)."""
+
+    def hz_to_mel(f):
+        f = np.asarray(f, np.float64)
+        mel = 3.0 * f / 200.0
+        log_region = f >= 1000.0
+        mel = np.where(
+            log_region,
+            15.0 + np.log(np.maximum(f, 1e-9) / 1000.0) / (np.log(6.4) / 27.0),
+            mel,
+        )
+        return mel
+
+    def mel_to_hz(m):
+        m = np.asarray(m, np.float64)
+        f = 200.0 * m / 3.0
+        log_region = m >= 15.0
+        f = np.where(log_region, 1000.0 * np.exp((np.log(6.4) / 27.0) * (m - 15.0)), f)
+        return f
+
+    fft_freqs = np.linspace(0, sample_rate / 2, n_fft // 2 + 1)
+    mel_pts = mel_to_hz(np.linspace(hz_to_mel(0.0), hz_to_mel(sample_rate / 2.0),
+                                    n_mels + 2))
+    fb = np.zeros((n_mels, n_fft // 2 + 1))
+    for i in range(n_mels):
+        lower, center, upper = mel_pts[i], mel_pts[i + 1], mel_pts[i + 2]
+        up = (fft_freqs - lower) / max(center - lower, 1e-9)
+        down = (upper - fft_freqs) / max(upper - center, 1e-9)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+        # slaney: normalize each filter to unit area
+        fb[i] *= 2.0 / (upper - lower)
+    return fb.astype(np.float32)
+
+
+def log_mel_spectrogram(audio: jnp.ndarray, n_mels: int = 80) -> jnp.ndarray:
+    """[T_samples] float32 in [-1, 1] -> [n_frames, n_mels] log-mel, whisper
+    conventions (reflect-pad, hann, log10, clamp to max-8, /4 + 1 scaling)."""
+    window = jnp.asarray(np.hanning(N_FFT + 1)[:-1].astype(np.float32))
+    pad = N_FFT // 2
+    audio = jnp.pad(audio, (pad, pad), mode="reflect")
+    n_frames = 1 + (audio.shape[0] - N_FFT) // HOP_LENGTH
+    idx = (jnp.arange(n_frames)[:, None] * HOP_LENGTH
+           + jnp.arange(N_FFT)[None, :])
+    frames = audio[idx] * window[None, :]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    power = jnp.abs(spec) ** 2  # [n_frames, n_fft//2+1]
+    # whisper drops the last frame (it uses frames[:-1])
+    power = power[:-1]
+    fb = jnp.asarray(mel_filterbank(n_mels))
+    mel = power @ fb.T
+    log_spec = jnp.log10(jnp.maximum(mel, 1e-10))
+    log_spec = jnp.maximum(log_spec, log_spec.max() - 8.0)
+    return (log_spec + 4.0) / 4.0
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper's fixed sinusoidal embedding (sin | cos concatenation)."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(
+        np.float32
+    )
+
+
+def init_params(cfg: WhisperConfig, key: jax.Array) -> Params:
+    """Random init for tests; serving loads HF checkpoints."""
+    d, h = cfg.d_model, cfg.num_heads
+    ks = iter(jax.random.split(key, 32))
+
+    def w(shape, fan_in):
+        return (jax.random.normal(next(ks), shape, jnp.float32)
+                * fan_in**-0.5).astype(cfg.dtype)
+
+    def attn_block(layers, cross=False):
+        blk = {
+            "wq": w((layers, d, d), d), "bq": jnp.zeros((layers, d), cfg.dtype),
+            "wk": w((layers, d, d), d),
+            "wv": w((layers, d, d), d), "bv": jnp.zeros((layers, d), cfg.dtype),
+            "wo": w((layers, d, d), d), "bo": jnp.zeros((layers, d), cfg.dtype),
+        }
+        return blk
+
+    def mlp_block(layers):
+        return {
+            "w1": w((layers, d, 4 * d), d),
+            "b1": jnp.zeros((layers, 4 * d), cfg.dtype),
+            "w2": w((layers, 4 * d, d), 4 * d),
+            "b2": jnp.zeros((layers, d), cfg.dtype),
+        }
+
+    def ln(layers=None, suffix=""):
+        shape = (layers, d) if layers else (d,)
+        return jnp.ones(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+    el, dl = cfg.encoder_layers, cfg.decoder_layers
+    enc_ln1_g, enc_ln1_b = ln(el)
+    enc_ln2_g, enc_ln2_b = ln(el)
+    dec_ln1_g, dec_ln1_b = ln(dl)
+    dec_lnx_g, dec_lnx_b = ln(dl)
+    dec_ln2_g, dec_ln2_b = ln(dl)
+    enc_lnf_g, enc_lnf_b = ln()
+    dec_lnf_g, dec_lnf_b = ln()
+
+    params: Params = {
+        # encoder conv frontend: [width, in, out] layout for lax.conv
+        "conv1_w": w((3, cfg.n_mels, d), 3 * cfg.n_mels),
+        "conv1_b": jnp.zeros((d,), cfg.dtype),
+        "conv2_w": w((3, d, d), 3 * d),
+        "conv2_b": jnp.zeros((d,), cfg.dtype),
+        "enc_pos": jnp.asarray(_sinusoids(cfg.n_audio_ctx, d), cfg.dtype),
+        "enc_attn": attn_block(el),
+        "enc_mlp": mlp_block(el),
+        "enc_ln1": (enc_ln1_g, enc_ln1_b),
+        "enc_ln2": (enc_ln2_g, enc_ln2_b),
+        "enc_lnf": (enc_lnf_g, enc_lnf_b),
+        # decoder
+        "tok_embed": w((cfg.vocab_size, d), d),
+        "dec_pos": w((cfg.n_text_ctx, d), d),
+        "dec_attn": attn_block(dl),
+        "dec_cross": attn_block(dl),
+        "dec_mlp": mlp_block(dl),
+        "dec_ln1": (dec_ln1_g, dec_ln1_b),
+        "dec_lnx": (dec_lnx_g, dec_lnx_b),
+        "dec_ln2": (dec_ln2_g, dec_ln2_b),
+        "dec_lnf": (dec_lnf_g, dec_lnf_b),
+    }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Transformer pieces
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, gb, eps=1e-5):
+    g, b = gb
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _heads(x, n):  # [B, T, D] -> [B, T, H, Dh]
+    b, t, d = x.shape
+    return x.reshape(b, t, n, d // n)
+
+
+def _mha(lp, x, kv, n_heads, mask=None):
+    """Attention with whisper's conventions (k has no bias, q scaled)."""
+    d = x.shape[-1]
+    q = _heads(x @ lp["wq"] + lp["bq"], n_heads)
+    k = _heads(kv @ lp["wk"], n_heads)
+    v = _heads(kv @ lp["wv"] + lp["bv"], n_heads)
+    scale = (d // n_heads) ** -0.25
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k * scale,
+                        preferred_element_type=jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return out.reshape(x.shape[0], x.shape[1], d) @ lp["wo"] + lp["bo"]
+
+
+def _mlp(lp, x):
+    return (jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
+            @ lp["w2"] + lp["b2"])
+
+
+def _stack_layer(tree, i):
+    return jax.tree.map(lambda a: a[i], tree)
+
+
+# ---------------------------------------------------------------------------
+# Encoder
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_audio(params: Params, cfg: WhisperConfig,
+                 mel: jnp.ndarray) -> jnp.ndarray:
+    """[B, n_frames, n_mels] -> [B, n_audio_ctx', D] encoder states.
+    n_frames must be even (conv2 stride 2)."""
+    x = mel.astype(cfg.dtype)
+    dn = ("NWC", "WIO", "NWC")
+    x = jax.nn.gelu(
+        lax.conv_general_dilated(x, params["conv1_w"], (1,), "SAME",
+                                 dimension_numbers=dn) + params["conv1_b"],
+        approximate=False,
+    )
+    x = jax.nn.gelu(
+        lax.conv_general_dilated(x, params["conv2_w"], (2,), "SAME",
+                                 dimension_numbers=dn) + params["conv2_b"],
+        approximate=False,
+    )
+    t = x.shape[1]
+    x = x + params["enc_pos"][None, :t]
+
+    def layer(carry, i):
+        attn = _stack_layer(params["enc_attn"], i)
+        mlp = _stack_layer(params["enc_mlp"], i)
+        ln1 = jax.tree.map(lambda a: a[i], params["enc_ln1"])
+        ln2 = jax.tree.map(lambda a: a[i], params["enc_ln2"])
+        h = _layer_norm(carry, ln1)
+        carry = carry + _mha(attn, h, h, cfg.num_heads)
+        h = _layer_norm(carry, ln2)
+        carry = carry + _mlp(mlp, h)
+        return carry, None
+
+    x, _ = lax.scan(layer, x, jnp.arange(cfg.encoder_layers))
+    return _layer_norm(x, params["enc_lnf"])
+
+
+# ---------------------------------------------------------------------------
+# Decoder
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decoder_logits(params: Params, cfg: WhisperConfig,
+                   tokens: jnp.ndarray,  # [B, T]
+                   enc_states: jnp.ndarray) -> jnp.ndarray:
+    """Full-sequence (teacher-forced) decoder: [B, T, vocab] fp32 logits.
+    Used for prompt processing and as the reference for the cached step."""
+    b, t = tokens.shape
+    x = params["tok_embed"][tokens] + params["dec_pos"][None, :t]
+    causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+
+    def layer(carry, i):
+        attn = _stack_layer(params["dec_attn"], i)
+        cross = _stack_layer(params["dec_cross"], i)
+        mlp = _stack_layer(params["dec_mlp"], i)
+        ln1 = jax.tree.map(lambda a: a[i], params["dec_ln1"])
+        lnx = jax.tree.map(lambda a: a[i], params["dec_lnx"])
+        ln2 = jax.tree.map(lambda a: a[i], params["dec_ln2"])
+        h = _layer_norm(carry, ln1)
+        carry = carry + _mha(attn, h, h, cfg.num_heads, mask=causal)
+        h = _layer_norm(carry, lnx)
+        carry = carry + _mha(cross, h, enc_states, cfg.num_heads)
+        h = _layer_norm(carry, ln2)
+        carry = carry + _mlp(mlp, h)
+        return carry, None
+
+    x, _ = lax.scan(layer, x, jnp.arange(cfg.decoder_layers))
+    x = _layer_norm(x, params["dec_lnf"])
+    return jnp.einsum("btd,vd->btv", x, params["tok_embed"],
+                      preferred_element_type=jnp.float32)
+
+
+def greedy_transcribe_tokens(params: Params, cfg: WhisperConfig,
+                             mel: jnp.ndarray, max_tokens: int = 128,
+                             language_token: int | None = None) -> list[int]:
+    """Greedy decode one utterance. Host loop over the teacher-forced decoder
+    (utterances are short; the jit cache sees pow2-bucketed lengths)."""
+    enc = encode_audio(params, cfg, mel[None])
+    lang = cfg.english_token if language_token is None else language_token
+    tokens = [cfg.sot_token, lang, cfg.transcribe_token,
+              cfg.no_timestamps_token]
+    prompt_len = len(tokens)
+    out: list[int] = []
+    for _ in range(max_tokens):
+        t = len(tokens)
+        bucket = 8
+        while bucket < t:
+            bucket *= 2
+        bucket = min(bucket, cfg.n_text_ctx)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :t] = tokens
+        logits = decoder_logits(params, cfg, jnp.asarray(padded), enc)
+        next_tok = int(np.asarray(logits[0, t - 1]).argmax())
+        if next_tok == cfg.eot_token:
+            break
+        tokens.append(next_tok)
+        out.append(next_tok)
+        if len(tokens) >= cfg.n_text_ctx:
+            break
+    del prompt_len
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint mapping (openai/whisper-* via transformers WhisperForConditionalGeneration)
+# ---------------------------------------------------------------------------
+
+def convert_hf_tensors(cfg: WhisperConfig, get) -> Params:
+    """Map transformers whisper tensor names onto our stacked pytree."""
+
+    def stack(fmt, transpose=False):
+        ws = []
+        for i in range(len_range):
+            w = get(fmt.format(i=i))
+            ws.append(w.T if transpose else w)
+        return np.stack(ws)
+
+    def attn(prefix, layers):
+        nonlocal len_range
+        len_range = layers
+        return {
+            "wq": stack(prefix + ".q_proj.weight", True),
+            "bq": stack(prefix + ".q_proj.bias"),
+            "wk": stack(prefix + ".k_proj.weight", True),
+            "wv": stack(prefix + ".v_proj.weight", True),
+            "bv": stack(prefix + ".v_proj.bias"),
+            "wo": stack(prefix + ".out_proj.weight", True),
+            "bo": stack(prefix + ".out_proj.bias"),
+        }
+
+    def mlp(prefix, layers):
+        nonlocal len_range
+        len_range = layers
+        return {
+            "w1": stack(prefix + ".fc1.weight", True),
+            "b1": stack(prefix + ".fc1.bias"),
+            "w2": stack(prefix + ".fc2.weight", True),
+            "b2": stack(prefix + ".fc2.bias"),
+        }
+
+    def ln_pair(prefix, layers=None):
+        nonlocal len_range
+        if layers:
+            len_range = layers
+            return (stack(prefix + ".weight"), stack(prefix + ".bias"))
+        return (get(prefix + ".weight"), get(prefix + ".bias"))
+
+    len_range = cfg.encoder_layers
+    el, dl = cfg.encoder_layers, cfg.decoder_layers
+    e = "model.encoder.layers.{i}"
+    d = "model.decoder.layers.{i}"
+    return {
+        # HF conv weight is [out, in, width] -> ours [width, in, out]
+        "conv1_w": np.transpose(get("model.encoder.conv1.weight"), (2, 1, 0)),
+        "conv1_b": get("model.encoder.conv1.bias"),
+        "conv2_w": np.transpose(get("model.encoder.conv2.weight"), (2, 1, 0)),
+        "conv2_b": get("model.encoder.conv2.bias"),
+        "enc_pos": get("model.encoder.embed_positions.weight"),
+        "enc_attn": attn(e + ".self_attn", el),
+        "enc_mlp": mlp(e, el),
+        "enc_ln1": ln_pair(e + ".self_attn_layer_norm", el),
+        "enc_ln2": ln_pair(e + ".final_layer_norm", el),
+        "enc_lnf": ln_pair("model.encoder.layer_norm"),
+        "tok_embed": get("model.decoder.embed_tokens.weight"),
+        "dec_pos": get("model.decoder.embed_positions.weight"),
+        "dec_attn": attn(d + ".self_attn", dl),
+        "dec_cross": attn(d + ".encoder_attn", dl),
+        "dec_mlp": mlp(d, dl),
+        "dec_ln1": ln_pair(d + ".self_attn_layer_norm", dl),
+        "dec_lnx": ln_pair(d + ".encoder_attn_layer_norm", dl),
+        "dec_ln2": ln_pair(d + ".final_layer_norm", dl),
+        "dec_lnf": ln_pair("model.decoder.layer_norm"),
+    }

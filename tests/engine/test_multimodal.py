@@ -1,0 +1,276 @@
+"""Multimodal engines (whisper ASR, TTS, diffusion images) + their HTTP
+routes: model-level correctness properties and OpenAI-contract responses.
+Strategy per SURVEY.md §4: tiny random-weight configs, in-process servers."""
+
+import asyncio
+import base64
+import io
+import wave
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmlb_tpu.engine.asr import AsrEngine, decode_wav, resample_linear
+from llmlb_tpu.engine.image import ImageEngine, encode_png
+from llmlb_tpu.engine.server import create_engine_app
+from llmlb_tpu.engine.service import Engine
+from llmlb_tpu.engine.tts import TtsEngine, encode_wav
+
+
+# ---------------------------------------------------------------------- audio
+
+
+def _tone(freq=440.0, seconds=0.3, rate=16000):
+    t = np.arange(int(seconds * rate)) / rate
+    return (0.5 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+def test_wav_roundtrip():
+    audio = _tone()
+    data = encode_wav(audio)
+    decoded, rate = decode_wav(data)
+    assert rate == 16000
+    np.testing.assert_allclose(decoded, audio, atol=1e-3)
+
+
+def test_decode_wav_rejects_garbage_as_client_error():
+    with pytest.raises(ValueError, match="WAV"):
+        decode_wav(b"ID3\x04not audio at all" * 10)
+
+
+def test_transcriptions_route_400_on_bad_audio():
+    async def run():
+        import aiohttp
+
+        eng = Engine.from_preset(
+            "debug-tiny", num_slots=1, slot_capacity=32, prefill_buckets=(16,),
+        )
+        try:
+            client = await _mm_client(eng, asr=AsrEngine.from_random(seed=9))
+            form = aiohttp.FormData()
+            form.add_field("file", b"not-a-wav", filename="x.mp3",
+                           content_type="audio/mpeg")
+            r = await client.post("/v1/audio/transcriptions", data=form)
+            assert r.status == 400
+            body = await r.json()
+            assert "decode" in body["error"]["message"]
+            await client.close()
+        finally:
+            eng.shutdown()
+    asyncio.run(run())
+
+
+def test_resample_halves_length():
+    audio = _tone(rate=32000)
+    out = resample_linear(audio, 32000, 16000)
+    assert abs(len(out) - len(audio) // 2) <= 1
+
+
+def test_mel_spectrogram_shape_and_finiteness():
+    from llmlb_tpu.models.whisper import HOP_LENGTH, log_mel_spectrogram
+
+    audio = _tone(seconds=0.5)
+    mel = np.asarray(log_mel_spectrogram(jnp.asarray(audio)))
+    assert mel.shape[1] == 80
+    assert abs(mel.shape[0] - len(audio) // HOP_LENGTH) <= 2
+    assert np.isfinite(mel).all()
+
+
+def test_whisper_decoder_causality():
+    """Changing a future token must not affect earlier positions' logits."""
+    from llmlb_tpu.models import whisper
+
+    eng = AsrEngine.from_random(seed=1)
+    cfg, params = eng.cfg, eng.params
+    mel = jnp.zeros((1, 32, cfg.n_mels), jnp.float32)
+    enc = whisper.encode_audio(params, cfg, mel)
+    toks = jnp.asarray([[cfg.sot_token, 5, 7, 9]], jnp.int32)
+    toks2 = toks.at[0, 3].set(11)
+    la = np.asarray(whisper.decoder_logits(params, cfg, toks, enc))
+    lb = np.asarray(whisper.decoder_logits(params, cfg, toks2, enc))
+    np.testing.assert_allclose(la[0, :3], lb[0, :3], rtol=1e-5, atol=1e-5)
+    assert not np.allclose(la[0, 3], lb[0, 3])
+
+
+def test_asr_transcribe_deterministic():
+    eng = AsrEngine.from_random(seed=2)
+    wav = encode_wav(_tone(seconds=0.2))
+    a = eng.transcribe_wav_bytes(wav, max_tokens=6)
+    b = eng.transcribe_wav_bytes(wav, max_tokens=6)
+    assert a == b  # greedy decode is deterministic
+
+
+def test_tts_produces_audio_and_respects_speed():
+    eng = TtsEngine.from_random(seed=3)
+    wav = eng.synthesize("hello world", voice="alloy")
+    audio, rate = decode_wav(wav)
+    assert rate == 16000
+    assert len(audio) > 1000
+    assert np.isfinite(audio).all()
+    fast = eng.synthesize("hello world", voice="alloy", speed=2.0)
+    fast_audio, _ = decode_wav(fast)
+    assert abs(len(fast_audio) - len(audio) / 2) < 0.1 * len(audio)
+
+
+def test_tts_voice_changes_output():
+    eng = TtsEngine.from_random(seed=3)
+    a, _ = decode_wav(eng.synthesize("same text", voice="alloy"))
+    b, _ = decode_wav(eng.synthesize("same text", voice="echo"))
+    assert not np.allclose(a, b)
+
+
+def test_tts_validation():
+    eng = TtsEngine.from_random(seed=3)
+    with pytest.raises(ValueError):
+        eng.synthesize("")
+    with pytest.raises(ValueError):
+        eng.synthesize("x", speed=9.0)
+
+
+def test_tts_checkpoint_roundtrip(tmp_path):
+    from llmlb_tpu.models import tts as tts_model
+
+    eng = TtsEngine.from_random(seed=4)
+    tts_model.save_checkpoint(str(tmp_path / "tts"), eng.cfg, eng.params)
+    cfg2, params2 = tts_model.load_checkpoint(str(tmp_path / "tts"))
+    assert cfg2 == eng.cfg
+    for k in eng.params:
+        a = jax.tree.leaves(eng.params[k])
+        b = jax.tree.leaves(params2[k])
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------- images
+
+
+def test_png_encoder_valid():
+    rgb = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(16, 16, 3)
+    png = encode_png(rgb)
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    # decode IDAT back and compare pixels (filter byte 0 per row)
+    idat_start = png.index(b"IDAT") + 4
+    idat_len = int.from_bytes(png[idat_start - 8: idat_start - 4], "big")
+    raw = zlib.decompress(png[idat_start: idat_start + idat_len])
+    rows = [raw[i * (1 + 48) + 1: (i + 1) * (1 + 48)] for i in range(16)]
+    np.testing.assert_array_equal(
+        np.frombuffer(b"".join(rows), np.uint8).reshape(16, 16, 3), rgb
+    )
+
+
+def test_image_generate_shapes_and_determinism():
+    eng = ImageEngine.from_random(seed=5, sample_steps=4)
+    a = eng.generate("a red square", n=2, seed=7)
+    b = eng.generate("a red square", n=2, seed=7)
+    assert len(a) == 2
+    assert a[0] == b[0]  # same seed -> same image
+    c = eng.generate("a red square", n=1, seed=8)
+    assert c[0] != a[0]  # different seed -> different image
+
+
+def test_image_prompt_conditioning_changes_output():
+    eng = ImageEngine.from_random(seed=5, sample_steps=4)
+    a = eng.generate("a cat", n=1, seed=3)
+    b = eng.generate("a dog", n=1, seed=3)
+    assert a[0] != b[0]
+
+
+def test_diffusion_checkpoint_roundtrip(tmp_path):
+    from llmlb_tpu.models import diffusion
+
+    eng = ImageEngine.from_random(seed=6, sample_steps=2)
+    diffusion.save_checkpoint(str(tmp_path / "diff"), eng.cfg, eng.params)
+    cfg2, params2 = diffusion.load_checkpoint(str(tmp_path / "diff"))
+    assert cfg2 == eng.cfg
+    for a, b in zip(jax.tree.leaves(eng.params), jax.tree.leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------------------ HTTP routes
+
+
+@pytest.fixture(scope="module")
+def mm_engine():
+    eng = Engine.from_preset(
+        "debug-tiny", num_slots=2, slot_capacity=64,
+        prefill_buckets=(16, 32), seed=0,
+    )
+    yield eng
+    eng.shutdown()
+
+
+async def _mm_client(engine, **services) -> TestClient:
+    client = TestClient(TestServer(
+        create_engine_app(engine, owns_engine=False, **services)
+    ))
+    await client.start_server()
+    return client
+
+
+def test_routes_404_when_service_absent(mm_engine):
+    async def run():
+        client = await _mm_client(mm_engine)
+        try:
+            r = await client.post("/v1/audio/speech", json={"input": "x"})
+            assert r.status == 404
+            r = await client.post("/v1/images/generations", json={"prompt": "x"})
+            assert r.status == 404
+        finally:
+            await client.close()
+    asyncio.run(run())
+
+
+def test_full_multimodal_server(mm_engine):
+    async def run():
+        asr = AsrEngine.from_random(seed=1)
+        tts = TtsEngine.from_random(seed=2)
+        image = ImageEngine.from_random(seed=3, sample_steps=2)
+        client = await _mm_client(mm_engine, asr=asr, tts=tts, image=image)
+        try:
+            # /v1/models lists all four with capabilities
+            r = await client.get("/v1/models")
+            body = await r.json()
+            caps = {m["id"]: m["capabilities"] for m in body["data"]}
+            assert caps[asr.model_id] == ["audio_transcription"]
+            assert caps[tts.model_id] == ["audio_speech"]
+            assert caps[image.model_id] == ["image_generation"]
+
+            # speech -> wav
+            r = await client.post("/v1/audio/speech", json={
+                "input": "hi", "voice": "nova"})
+            assert r.status == 200
+            assert r.content_type == "audio/wav"
+            wav = await r.read()
+            with wave.open(io.BytesIO(wav), "rb") as wf:
+                assert wf.getframerate() == 16000
+
+            # transcription accepts that wav back (multipart)
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("file", wav, filename="a.wav",
+                           content_type="audio/wav")
+            form.add_field("model", asr.model_id)
+            r = await client.post("/v1/audio/transcriptions", data=form)
+            assert r.status == 200
+            assert "text" in await r.json()
+
+            # images
+            r = await client.post("/v1/images/generations", json={
+                "prompt": "a tiny square", "n": 1})
+            assert r.status == 200
+            data = (await r.json())["data"]
+            png = base64.b64decode(data[0]["b64_json"])
+            assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+            # validation errors
+            r = await client.post("/v1/images/generations", json={"n": 1})
+            assert r.status == 400
+            r = await client.post("/v1/audio/speech", json={})
+            assert r.status == 400
+        finally:
+            await client.close()
+    asyncio.run(run())
