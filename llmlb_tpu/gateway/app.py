@@ -21,6 +21,7 @@ from llmlb_tpu.gateway import (
     api_cloud,
     api_dashboard,
     api_media,
+    api_models,
     api_openai,
 )
 from llmlb_tpu.gateway.app_state import AppState
@@ -267,6 +268,36 @@ def create_app(state: AppState) -> web.Application:
     )
 
     # ---- audit / settings / system
+    # ---- model registry + catalog + per-endpoint model management
+    r.add_post("/api/models/register", api_models.register_model)
+    r.add_get("/api/models", api_models.list_registered_models)
+    r.add_delete("/api/models/{name}", api_models.delete_registered_model)
+    r.add_get(
+        "/api/models/registry/{model}/manifest.json",
+        api_models.get_model_manifest,
+    )
+    r.add_get("/api/catalog/search", api_models.catalog_search)
+    r.add_post(
+        "/api/endpoints/{endpoint_id}/models/download",
+        api_models.download_endpoint_model,
+    )
+    r.add_get(
+        "/api/endpoints/models/download/{task_id}",
+        api_models.download_progress,
+    )
+    r.add_delete(
+        "/api/endpoints/{endpoint_id}/models/{model}",
+        api_models.delete_endpoint_model,
+    )
+    r.add_get(
+        "/api/endpoints/{endpoint_id}/models/{model}/info",
+        api_models.endpoint_model_info,
+    )
+    r.add_post(
+        "/api/endpoints/{endpoint_id}/chat/completions",
+        api_models.playground_chat_proxy,
+    )
+
     r.add_get("/api/audit-log", api_admin.query_audit_log)
     r.add_post("/api/audit-log/verify", api_admin.verify_audit_chain)
     r.add_get("/api/dashboard/settings", api_admin.get_settings)

@@ -381,3 +381,56 @@ class Database:
 
     def list_settings(self) -> dict[str, str]:
         return {r["key"]: r["value"] for r in self.query("SELECT * FROM settings")}
+
+    # ------------------------------------------------- registered models
+    # Parity: reference db/models.rs — metadata+manifest only, no weights
+    # (api/models.rs:1021 register, :1167 manifest serving).
+
+    def register_model(self, name: str, source_repo: str | None,
+                       format_: str | None, capabilities: list[str],
+                       manifest: dict) -> str:
+        model_id = uuid.uuid4().hex
+        self.execute(
+            """INSERT INTO registered_models
+               (id, name, source_repo, format, capabilities, manifest, created_at)
+               VALUES (?,?,?,?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET source_repo=excluded.source_repo,
+               format=excluded.format, capabilities=excluded.capabilities,
+               manifest=excluded.manifest""",
+            (model_id, name, source_repo, format_, json.dumps(capabilities),
+             json.dumps(manifest), time.time()),
+        )
+        return model_id
+
+    def list_registered_models(self) -> list[dict]:
+        return [
+            {
+                "id": r["id"], "name": r["name"],
+                "source_repo": r["source_repo"], "format": r["format"],
+                "capabilities": json.loads(r["capabilities"] or "[]"),
+                "created_at": r["created_at"],
+            }
+            for r in self.query(
+                "SELECT * FROM registered_models ORDER BY created_at DESC"
+            )
+        ]
+
+    def get_registered_model(self, name: str) -> dict | None:
+        r = self.query_one(
+            "SELECT * FROM registered_models WHERE name=?", (name,)
+        )
+        if r is None:
+            return None
+        return {
+            "id": r["id"], "name": r["name"], "source_repo": r["source_repo"],
+            "format": r["format"],
+            "capabilities": json.loads(r["capabilities"] or "[]"),
+            "manifest": json.loads(r["manifest"] or "null"),
+            "created_at": r["created_at"],
+        }
+
+    def delete_registered_model(self, name: str) -> bool:
+        cur = self.execute(
+            "DELETE FROM registered_models WHERE name=?", (name,)
+        )
+        return cur.rowcount > 0
