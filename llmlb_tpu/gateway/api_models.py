@@ -203,11 +203,16 @@ async def catalog_search(request: web.Request) -> web.Response:
 # ---------------------------------------------------------------------------
 
 _DOWNLOAD_TASKS: dict[str, dict] = {}  # in-memory task store (pruned)
+# Strong references to in-flight download asyncio.Tasks: the event loop only
+# keeps weak refs, so without this a long pull can be GC'd mid-flight.
+_ACTIVE_DOWNLOADS: set[asyncio.Task] = set()
 
 
 def _prune_tasks(max_tasks: int = 200) -> None:
     if len(_DOWNLOAD_TASKS) > max_tasks:
-        for key in sorted(_DOWNLOAD_TASKS,
+        evictable = [k for k, t in _DOWNLOAD_TASKS.items()
+                     if t["status"] != "running"]
+        for key in sorted(evictable,
                           key=lambda k: _DOWNLOAD_TASKS[k]["started_at"])[:50]:
             _DOWNLOAD_TASKS.pop(key, None)
 
@@ -263,7 +268,9 @@ async def download_endpoint_model(request: web.Request) -> web.Response:
             task["status"] = "failed"
             task["error"] = str(e)
 
-    asyncio.create_task(run())
+    t = asyncio.create_task(run())
+    _ACTIVE_DOWNLOADS.add(t)
+    t.add_done_callback(_ACTIVE_DOWNLOADS.discard)
     return web.json_response({"task_id": task_id}, status=202)
 
 

@@ -93,7 +93,12 @@ async def gate_middleware(request: web.Request, handler):
     (inference_gate.rs:200-230); otherwise counts the request in flight for the
     full (streaming) response lifetime."""
     state: AppState = request.app["state"]
-    if request.path.startswith("/v1/"):
+    # Playground proxy is inference too (reference gates it: api/mod.rs:460-479).
+    is_inference = request.path.startswith("/v1/") or (
+        request.path.startswith("/api/endpoints/")
+        and request.path.endswith("/chat/completions")
+    )
+    if is_inference:
         if state.gate.rejecting:
             return web.json_response(
                 {"error": {"message": "server is draining for update",

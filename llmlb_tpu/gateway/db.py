@@ -400,7 +400,11 @@ class Database:
             (model_id, name, source_repo, format_, json.dumps(capabilities),
              json.dumps(manifest), time.time()),
         )
-        return model_id
+        # On re-registration the UPDATE path keeps the existing row's id, so
+        # return the id actually stored rather than the freshly generated one.
+        row = self.query_one(
+            "SELECT id FROM registered_models WHERE name=?", (name,))
+        return row["id"] if row else model_id
 
     def list_registered_models(self) -> list[dict]:
         return [

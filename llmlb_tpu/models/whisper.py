@@ -61,10 +61,42 @@ class WhisperConfig:
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
 
+    @property
+    def is_multilingual(self) -> bool:
+        # English-only vocabs (openai/whisper-*.en) are 51864 tokens and lack
+        # the language/task tokens of the 51865+ multilingual vocab.
+        return self.vocab_size >= 51865
+
     @classmethod
     def from_hf_config(cls, hf: dict, dtype=jnp.float32) -> "WhisperConfig":
+        vocab_size = hf["vocab_size"]
+        # Derive special-token ids from the checkpoint config instead of
+        # assuming the multilingual layout: .en checkpoints use
+        # eot=50256, sot=50257 and have no language/transcribe tokens.
+        eot = hf.get("eos_token_id", 50257)
+        sot = hf.get("decoder_start_token_id", 50258)
+        multilingual = vocab_size >= 51865
+        if multilingual:
+            # large-v3 (vocab 51866) adds <|yue|> at 50358, shifting the task
+            # block up by one; derive the offset from the vocab size.
+            shift = vocab_size - 51865
+            transcribe = 50359 + shift
+            no_timestamps = 50363 + shift
+            english = 50259
+            # Honour forced_decoder_ids when present ([(1, lang), (2, task)]).
+            for pos, tok in (hf.get("forced_decoder_ids") or []):
+                if pos == 1 and tok is not None:
+                    english = tok
+                elif pos == 2 and tok is not None:
+                    transcribe = tok
+        else:
+            # English-only: no language/task tokens exist; mark them -1 so
+            # greedy_transcribe_tokens skips them when building the prompt.
+            transcribe = -1
+            no_timestamps = 50362 if vocab_size > 50362 else -1
+            english = -1
         return cls(
-            vocab_size=hf["vocab_size"],
+            vocab_size=vocab_size,
             n_mels=hf.get("num_mel_bins", 80),
             d_model=hf["d_model"],
             encoder_layers=hf["encoder_layers"],
@@ -72,6 +104,11 @@ class WhisperConfig:
             num_heads=hf["encoder_attention_heads"],
             n_audio_ctx=hf.get("max_source_positions", 1500),
             n_text_ctx=hf.get("max_target_positions", 448),
+            sot_token=sot,
+            eot_token=eot,
+            transcribe_token=transcribe,
+            no_timestamps_token=no_timestamps,
+            english_token=english,
             dtype=dtype,
         )
 
@@ -339,10 +376,13 @@ def greedy_transcribe_tokens(params: Params, cfg: WhisperConfig,
     """Greedy decode one utterance. Host loop over the teacher-forced decoder
     (utterances are short; the jit cache sees pow2-bucketed lengths)."""
     enc = encode_audio(params, cfg, mel[None])
+    # English-only checkpoints have no language/task tokens (marked -1 by
+    # from_hf_config): prompt is just <|startoftranscript|>[<|notimestamps|>].
     lang = cfg.english_token if language_token is None else language_token
-    tokens = [cfg.sot_token, lang, cfg.transcribe_token,
-              cfg.no_timestamps_token]
-    prompt_len = len(tokens)
+    tokens = [cfg.sot_token]
+    for tok in (lang, cfg.transcribe_token, cfg.no_timestamps_token):
+        if tok is not None and tok >= 0:
+            tokens.append(tok)
     out: list[int] = []
     for _ in range(max_tokens):
         t = len(tokens)
@@ -360,7 +400,6 @@ def greedy_transcribe_tokens(params: Params, cfg: WhisperConfig,
         out.append(next_tok)
         if len(tokens) >= cfg.n_text_ctx:
             break
-    del prompt_len
     return out
 
 
