@@ -59,7 +59,7 @@ PRESETS: dict[str, LlamaConfig] = {
     "debug-tiny": LlamaConfig(
         vocab_size=512, hidden_size=128, intermediate_size=256,
         num_layers=2, num_heads=8, num_kv_heads=4, dtype=jnp.float32,
-        max_position_embeddings=128,
+        max_position_embeddings=512,
     ),
 }
 

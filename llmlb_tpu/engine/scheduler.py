@@ -69,6 +69,13 @@ class _Slot:
     request: Request | None = None
     generated: int = 0
     eos_id: int = -1
+    # Chunked-prefill progress: tokens of the prompt already in the KV cache.
+    # While prefilling is True the slot is excluded from decode emission and
+    # its device seq_len is parked at capacity-1 so the batched decode step's
+    # garbage writes land in the (unused) last cell, never inside the region
+    # the chunks are filling.
+    prefilling: bool = False
+    prefill_pos: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,12 +178,13 @@ class EngineCore:
         n = len(request.prompt_ids)
         if n == 0:
             raise ValueError("prompt must contain at least one token")
-        max_prompt = self.prefill_buckets[-1] if self.prefill_buckets else 0
-        if n > max_prompt:
+        if not self.prefill_buckets:
             raise ValueError(
-                f"prompt of {n} tokens exceeds the largest prefill bucket "
-                f"({max_prompt})"
+                "engine has no prefill buckets (slot capacity smaller than "
+                "every configured bucket)"
             )
+        # Prompts beyond the largest one-shot bucket run through chunked
+        # prefill (prefill_extend_slots); the only hard cap is slot capacity.
         if n + 1 >= self.slot_capacity:
             raise ValueError(
                 f"prompt of {n} tokens does not fit the slot capacity "
@@ -217,6 +225,10 @@ class EngineCore:
             did_work = False
             try:
                 did_work |= self._try_insert()
+                # At most ONE prefill chunk per iteration: decode steps run
+                # between chunks, so active slots keep emitting tokens during
+                # a long prompt's prefill (prefill/decode interleaving).
+                did_work |= self._advance_prefill()
                 did_work |= self._decode_active()
             except Exception:  # pragma: no cover - defensive: fail loud, keep serving
                 log.exception("engine step failed; resetting engine state")
@@ -254,6 +266,24 @@ class EngineCore:
         if room <= 0:
             request.events.put(("error", "prompt does not fit slot capacity"))
             return True
+
+        slot = self.slots[slot_id]
+        max_oneshot = self.prefill_buckets[-1] if self.prefill_buckets else 0
+        if n > max_oneshot:
+            # Long prompt: chunked prefill. Claim the slot, park its device
+            # seq_len at capacity-1 (batched decode's garbage writes for this
+            # row land in the unused last cell), and let _advance_prefill feed
+            # chunks between decode steps.
+            slot.request = request
+            slot.generated = 0
+            slot.prefilling = True
+            slot.prefill_pos = 0
+            self._seq_lens[slot_id] = 0
+            self._d_seq_lens = self._d_seq_lens.at[slot_id].set(
+                self.slot_capacity - 1
+            )
+            return True
+
         bucket = self._bucket_for(n)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = request.prompt_ids
@@ -269,14 +299,61 @@ class EngineCore:
             self.mesh,
         )
 
-        slot = self.slots[slot_id]
         slot.request = request
         slot.generated = 0
-        self._seq_lens[slot_id] = n
+        self._activate_slot(slot_id, request, n, logits)
+        return True
 
-        # Sample the first token straight from the prefill logits, then land
-        # the slot's device-side state in one scatter (insert-time only; the
-        # decode loop never uploads host state).
+    def _advance_prefill(self) -> bool:
+        """Feed ONE chunk of one prefilling slot's prompt into the KV cache."""
+        slot_id = next(
+            (i for i, s in enumerate(self.slots) if s.prefilling), None
+        )
+        if slot_id is None:
+            return False
+        slot = self.slots[slot_id]
+        request = slot.request
+        assert request is not None
+        if request.cancelled:
+            request.finished_at = time.monotonic()
+            request.events.put(("done", "cancelled"))
+            slot.request = None
+            slot.prefilling = False
+            slot.generated = 0
+            return True
+
+        n = len(request.prompt_ids)
+        start = slot.prefill_pos
+        chunk_max = self.prefill_buckets[-1]
+        chunk_len = min(chunk_max, n - start)
+        bucket = self._bucket_for(chunk_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :chunk_len] = request.prompt_ids[start:start + chunk_len]
+
+        logits, self.cache_k, self.cache_v = self.family.prefill_extend_slots(
+            self.params,
+            self.cfg,
+            jnp.asarray(ids),
+            jnp.asarray([chunk_len], np.int32),
+            jnp.asarray([start], np.int32),
+            jnp.asarray([slot_id], np.int32),
+            self.cache_k,
+            self.cache_v,
+            self.mesh,
+        )
+
+        slot.prefill_pos = start + chunk_len
+        if slot.prefill_pos >= n:
+            slot.prefilling = False
+            self._activate_slot(slot_id, request, n, logits)
+        return True
+
+    def _activate_slot(self, slot_id: int, request: Request, n: int,
+                       logits) -> None:
+        """Sample the first token from prefill logits and land the slot's
+        device-side state in one scatter (insert-time only; the decode hot
+        loop never uploads host state)."""
+        self._seq_lens[slot_id] = n
         self._key, sk = jax.random.split(self._key)
         s = request.sampling
         temp = jnp.float32(s.temperature)
@@ -292,10 +369,12 @@ class EngineCore:
 
         request.first_token_at = time.monotonic()
         self._emit(slot_id, int(first))
-        return True
 
     def _decode_active(self) -> bool:
-        active = [i for i, s in enumerate(self.slots) if s.request is not None]
+        active = [
+            i for i, s in enumerate(self.slots)
+            if s.request is not None and not s.prefilling
+        ]
         if not active:
             return False
 
@@ -358,6 +437,9 @@ class EngineCore:
             if slot.request is not None:
                 slot.request.events.put(("error", message))
                 slot.request = None
+            slot.prefilling = False
+            slot.prefill_pos = 0
+            slot.generated = 0
         while True:
             try:
                 self.pending.get_nowait().events.put(("error", message))

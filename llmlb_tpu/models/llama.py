@@ -28,7 +28,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from llmlb_tpu.ops.attention import gqa_attention_decode, gqa_attention_prefill
+from llmlb_tpu.ops.attention import (
+    gqa_attention_decode,
+    gqa_attention_extend,
+    gqa_attention_prefill,
+)
 from llmlb_tpu.ops.norms import rms_norm
 from llmlb_tpu.ops.rope import RopeScaling, apply_rope, rope_frequencies
 from llmlb_tpu.parallel.mesh import validate_tp
@@ -382,6 +386,77 @@ def prefill_into_slots(
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
         make_write_kv_slots(slot_ids),
+    )
+
+
+def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
+                         cache_k, cache_v, *, stacked_names=None,
+                         mlp_fn=_default_mlp_fn):
+    """Shared chunked-prefill body: process a [B, T] chunk of prompt tokens
+    whose slots already hold `start_pos` tokens of KV. Queries attend over the
+    full slot row (earlier chunks + causal within this chunk). Backs long
+    prompts that exceed the one-shot prefill buckets.
+
+    Padding tokens (i >= chunk_lens) write garbage K/V at positions beyond the
+    chunk; those cells sit past the valid range (masked by every later
+    attention) and are overwritten in place when the sequence grows into them.
+    """
+    _, t = input_ids.shape
+    capacity = cache_k.shape[2]
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    offs = jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = start_pos[:, None] + offs  # [B, T] global positions
+    write_pos = jnp.minimum(positions, capacity - 1)
+    token_valid = offs < chunk_lens[:, None]  # [B, T]
+
+    x = params["embed"][input_ids]  # [B, T, E]
+    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+
+    def layer(carry_x, layer_in):
+        lp, ck, cv = layer_in
+
+        def attn_fn(q, k, v):
+            nonlocal ck, cv  # cache write precedes attention over the cache
+            ck = ck.at[slot_ids[:, None], write_pos].set(k.astype(ck.dtype))
+            cv = cv.at[slot_ids[:, None], write_pos].set(v.astype(cv.dtype))
+            return gqa_attention_extend(
+                q, ck[slot_ids], cv[slot_ids], positions
+            )
+
+        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid)
+        return carry_x, (ck, cv)
+
+    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
+
+    last = jnp.maximum(chunk_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
+    logits = _unembed(cfg, params, x_last)
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_extend_slots(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded chunk
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid tokens in this chunk
+    start_pos: jnp.ndarray,  # [B] int32 — tokens already in the slot's cache
+    slot_ids: jnp.ndarray,  # [B] int32 — target rows in the global slot cache
+    cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D]
+    cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
+):
+    """Chunked prefill: append a chunk of prompt tokens to slots that already
+    hold `start_pos` tokens, attending over everything so far. Lets the engine
+    serve prompts far beyond the one-shot prefill buckets while decode steps
+    interleave between chunks. Returns (chunk-last logits [B, V] fp32, caches).
+    """
+    return _prefill_extend_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
+        cache_k, cache_v,
     )
 
 

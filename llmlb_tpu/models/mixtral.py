@@ -24,6 +24,7 @@ from jax.sharding import Mesh
 from llmlb_tpu.models.llama import (
     LlamaConfig,
     _decode_impl,
+    _prefill_extend_impl,
     _prefill_impl,
     _write_kv_fresh,
     make_write_kv_slots,
@@ -192,6 +193,21 @@ def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
         make_write_kv_slots(slot_ids),
+        stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_extend_slots(params, cfg: MixtralConfig, input_ids, chunk_lens,
+                         start_pos, slot_ids, cache_k, cache_v,
+                         mesh: Mesh | None = None):
+    """Chunked-prefill append path. Same contract as llama.prefill_extend_slots."""
+    b, t = input_ids.shape
+    return _prefill_extend_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
+        cache_k, cache_v,
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
     )

@@ -61,12 +61,6 @@ class WhisperConfig:
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
 
-    @property
-    def is_multilingual(self) -> bool:
-        # English-only vocabs (openai/whisper-*.en) are 51864 tokens and lack
-        # the language/task tokens of the 51865+ multilingual vocab.
-        return self.vocab_size >= 51865
-
     @classmethod
     def from_hf_config(cls, hf: dict, dtype=jnp.float32) -> "WhisperConfig":
         vocab_size = hf["vocab_size"]

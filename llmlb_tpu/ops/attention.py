@@ -82,6 +82,41 @@ def gqa_attention_prefill(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
+def gqa_attention_extend(
+    q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
+    k_cache: jnp.ndarray,  # [B, S, K, D] — slot cache incl. this chunk's keys
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    q_positions: jnp.ndarray,  # [B, T] int32 — global position of each query
+) -> jnp.ndarray:
+    """Chunked-prefill attention: a chunk of T queries attends causally against
+    the full slot cache (earlier chunks + this chunk). Query i at global
+    position p may see cache positions <= p. Returns [B, T, H, D].
+
+    Generalizes decode (T=1); backs the engine's chunked long-prompt prefill
+    path (no reference counterpart — SURVEY.md §5 long-context is greenfield).
+    """
+    b, t, h, d = q.shape
+    k_heads = k_cache.shape[2]
+    qg = _split_gqa(q, k_heads)  # [B, T, K, G, D]
+    scale = d**-0.5
+
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale  # [B, K, G, T, S]
+
+    s = k_cache.shape[1]
+    cap_pos = jnp.arange(s, dtype=jnp.int32)
+    mask = cap_pos[None, None, :] <= q_positions[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs.astype(q.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
 def gqa_attention_decode(
     q: jnp.ndarray,  # [B, 1, H, D]
     k_cache: jnp.ndarray,  # [B, S, K, D] — slot-capacity cache incl. current token

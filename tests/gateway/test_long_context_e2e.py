@@ -1,0 +1,73 @@
+"""Long-context e2e (VERDICT r1 item 4 done-criterion): a prompt 4x beyond the
+engine's largest one-shot prefill bucket streams a completion through the
+gateway's /v1/chat/completions SSE path."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.engine.server import create_engine_app
+from llmlb_tpu.engine.service import Engine
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from tests.support import GatewayHarness
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # largest bucket 32; slot capacity leaves room for a 4x-bucket prompt
+    eng = Engine.from_preset(
+        "debug-tiny", model_id="tpu-long", num_slots=2, slot_capacity=256,
+        prefill_buckets=(16, 32),
+    )
+    yield eng
+    eng.shutdown()
+
+
+def test_long_prompt_streams_through_gateway(engine):
+    async def run():
+        gw = await GatewayHarness.create()
+        engine_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await engine_server.start_server()
+        engine_url = f"http://127.0.0.1:{engine_server.port}"
+        gw.state.health_checker = EndpointHealthChecker(
+            gw.state.registry, gw.state.load_manager, gw.state.db,
+            gw.state.http, gw.state.events, interval_s=3600, timeout_s=5.0,
+        )
+        try:
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": engine_url, "name": "tpu-long"}, headers=headers)
+            assert r.status == 201, await r.text()
+            created = await r.json()
+            assert created["status"] == "online", created
+            assert [m["model_id"] for m in created["models"]] == ["tpu-long"], created
+
+            iheaders = await gw.inference_headers()
+            # ~135 chars -> >=130 byte-tokenizer tokens: 4x the 32 bucket
+            long_prompt = "long context serving " * 7
+            assert len(long_prompt) >= 4 * 32
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-long", "max_tokens": 5, "temperature": 0,
+                "stream": True,
+                "messages": [{"role": "user", "content": long_prompt}],
+            }, headers=iheaders, timeout=120)
+            assert r.status == 200, await r.text()
+            raw = (await r.read()).decode()
+            assert raw.strip().endswith("data: [DONE]")
+            chunks = [
+                json.loads(l[6:]) for l in raw.splitlines()
+                if l.startswith("data: ") and l != "data: [DONE]"
+            ]
+            assert any(
+                c["choices"] and c["choices"][0]["delta"].get("content")
+                for c in chunks if c.get("choices")
+            )
+            usage = next(c["usage"] for c in reversed(chunks) if c.get("usage"))
+            assert usage["prompt_tokens"] >= 4 * 32
+            assert usage["completion_tokens"] >= 1
+        finally:
+            await engine_server.close()
+            await gw.close()
+    asyncio.run(run())
