@@ -1,96 +1,254 @@
-"""Headline bench: continuous-decode throughput, tokens/sec/chip.
+"""Headline bench: serving throughput through EngineCore (continuous batching).
 
-Runs the 1B-class bench model (random weights — checkpoint download is not
-available in the bench environment) with a full decode batch and measures
-sustained decode throughput per chip, the BASELINE.md "tokens/sec/chip" target
-(the reference publishes no model-serving numbers; `vs_baseline` is measured
-against A100_CLASS_TOKS_PER_SEC, a vLLM-on-A100-class per-chip decode rate for
-1B-class models, per the BASELINE.json north-star framing).
+Measures what BASELINE.md asks for — tokens/sec/chip on the 1B-class bench
+model served through the engine's continuous-batching step loop (the same code
+path /v1/chat/completions runs), plus TTFT p50 and an MFU estimate.
 
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+Robustness (VERDICT r1 item 1): the TPU backend is probed in a SUBPROCESS with
+a bounded timeout and one retry, because a broken axon tunnel hangs backend
+init indefinitely. If the TPU is unreachable the bench falls back to a CPU run
+of the same engine path on a tiny config and reports the probe diagnostics —
+the output is always exactly ONE JSON line on stdout:
+
+    {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N, ...}
+
+All diagnostics go to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 # Stand-in baseline: per-chip decode throughput of a 1B-class model on a
-# vLLM/A100-class serving stack at batch 32 (public figures cluster ~2-3k tok/s
-# per accelerator for 1B models; we take the high end as the bar to beat).
+# vLLM/A100-class serving stack at batch ~32 (public figures cluster ~2-3k
+# tok/s per accelerator for 1B models; we take the high end as the bar).
 A100_CLASS_TOKS_PER_SEC = 3000.0
 
-BATCH = 32
-CAPACITY = 1024
-PREFILL_LEN = 128
-DECODE_STEPS = 64
-WARMUP_STEPS = 8
+# bf16 peak FLOPs by TPU generation (for the MFU estimate).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+PROBE_TIMEOUT_S = 150
+PROBE_RETRIES = 2
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_tpu() -> tuple[bool, str]:
+    """Check TPU backend health in a subprocess so a hung init can't wedge the
+    bench. Returns (ok, diagnostic)."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+        "(x @ x).block_until_ready()\n"
+        "print(jax.default_backend(), len(d), getattr(d[0], 'device_kind', '?'))\n"
+    )
+    last = ""
+    for attempt in range(1, PROBE_RETRIES + 1):
+        log(f"TPU probe attempt {attempt}/{PROBE_RETRIES} "
+            f"(timeout {PROBE_TIMEOUT_S}s)")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {PROBE_TIMEOUT_S}s (backend init hang)"
+            log(last)
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            out = r.stdout.strip().splitlines()[-1]
+            log(f"TPU probe OK: {out}")
+            if out.startswith(("tpu", "axon")):
+                return True, out
+            last = f"backend is {out!r}, not tpu"
+            return False, last
+        last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["unknown"]
+        last = f"probe rc={r.returncode}: {last[0][:300]}"
+        log(last)
+    return False, last
+
+
+def run_engine_bench(platform: str) -> dict:
+    """Bench the continuous-batching engine loop. Called AFTER the jax
+    platform has been decided (TPU left alone / CPU forced)."""
+    import jax
+
+    from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+    from llmlb_tpu.engine.presets import get_preset
+
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        preset = "tinyllama-1.1b"
+        num_slots, capacity = 32, 1024
+        buckets = (128, 256, 512)
+        prompt_len, warm_tokens, max_tokens = 128, 16, 512
+        measure_s = 10.0
+    else:
+        preset = "debug-tiny"
+        num_slots, capacity = 4, 128
+        buckets = (16, 32)
+        prompt_len, warm_tokens, max_tokens = 16, 4, 96
+        measure_s = 3.0
+
+    cfg = get_preset(preset)
+    devices = jax.devices()
+    n_chips = len(devices) if on_tpu else 1
+    kind = getattr(devices[0], "device_kind", "unknown")
+    log(f"backend={jax.default_backend()} devices={n_chips} kind={kind}")
+
+    t0 = time.perf_counter()
+    core = EngineCore(
+        cfg, num_slots=num_slots, slot_capacity=capacity,
+        prefill_buckets=buckets, seed=0,
+    )
+    core.start()
+    log(f"engine up in {time.perf_counter() - t0:.1f}s "
+        f"(slots={num_slots} cap={capacity})")
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def make_request(max_toks: int) -> Request:
+        ids = list(rng.integers(1, cfg.vocab_size, size=(prompt_len,)))
+        return Request(
+            prompt_ids=ids,
+            sampling=SamplingParams(temperature=0.7, top_p=0.95,
+                                    max_tokens=max_toks),
+        )
+
+    def drain_until_done(reqs: list[Request], timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        for r in reqs:
+            while time.monotonic() < deadline:
+                kind_, _val = r.events.get(timeout=max(1.0, deadline - time.monotonic()))
+                if kind_ in ("done", "error"):
+                    break
+
+    # ---- warmup: trigger every compile (prefill bucket + decode + sampling)
+    t0 = time.perf_counter()
+    warm = [make_request(warm_tokens) for _ in range(2)]
+    for r in warm:
+        core.submit(r)
+    drain_until_done(warm, timeout=1200)
+    log(f"warmup (compiles) in {time.perf_counter() - t0:.1f}s")
+
+    # ---- measured run: fill all slots, sample steady-state throughput from
+    # the engine's own token counter while every slot stays active.
+    reqs = [make_request(max_tokens) for _ in range(num_slots)]
+    submit_t = time.monotonic()
+    for r in reqs:
+        core.submit(r)
+
+    while any(r.first_token_at is None for r in reqs):
+        time.sleep(0.005)
+        if time.monotonic() - submit_t > 1200:
+            raise RuntimeError("requests never reached first token")
+    ttfts = sorted((r.first_token_at - r.submitted_at) for r in reqs)
+    ttft_p50_ms = 1000.0 * ttfts[len(ttfts) // 2]
+    ttft_p99_ms = 1000.0 * ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    stats0 = core.stats()
+    t0 = time.monotonic()
+    while True:
+        time.sleep(0.25)
+        s = core.stats()
+        if s.active_slots < num_slots or time.monotonic() - t0 >= measure_s:
+            break
+    stats1 = core.stats()
+    t1 = time.monotonic()
+    window_tokens = stats1.total_tokens - stats0.total_tokens
+    window_s = t1 - t0
+    toks_per_sec = window_tokens / window_s
+
+    drain_until_done(reqs, timeout=1200)
+    core.stop()
+
+    per_chip = toks_per_sec / max(n_chips, 1)
+
+    # MFU: decode FLOPs/token ~= 2 * params. Count params from the pytree.
+    n_params = sum(int(np.prod(v.shape)) for v in core.params.values())
+    peak = next((f for k, f in _PEAK_FLOPS.items()
+                 if k in str(kind).lower().replace(" ", "")), None)
+    mfu = (2.0 * n_params * per_chip / peak) if (peak and on_tpu) else None
+
+    kernels = "pallas" if (on_tpu and n_chips == 1 and os.environ.get(
+        "LLMLB_TPU_ATTENTION", "auto") != "xla") else "xla"
+    log(f"steady-state: {window_tokens} tokens / {window_s:.2f}s = "
+        f"{toks_per_sec:.1f} tok/s ({per_chip:.1f}/chip), "
+        f"ttft p50 {ttft_p50_ms:.1f}ms, kernels={kernels}")
+
+    return {
+        "metric": f"engine_decode_tokens_per_sec_per_chip_{preset}",
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / A100_CLASS_TOKS_PER_SEC, 4),
+        "platform": "tpu" if on_tpu else "cpu",
+        "device_kind": str(kind),
+        "n_chips": n_chips,
+        "model": preset,
+        "batch_slots": num_slots,
+        "ttft_p50_ms": round(ttft_p50_ms, 1),
+        "ttft_p99_ms": round(ttft_p99_ms, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "attention_kernels": kernels,
+        "through_engine_core": True,
+    }
 
 
 def main() -> None:
-    from llmlb_tpu.engine.presets import get_preset
-    from llmlb_tpu.models.llama import (
-        decode_step,
-        init_kv_cache,
-        init_params,
-        prefill,
-    )
-    from llmlb_tpu.ops.sampling import sample_tokens
+    ok, diag = probe_tpu()
+    if ok:
+        try:
+            result = run_engine_bench("tpu")
+        except Exception as e:  # contract: one JSON line even on TPU failure
+            import traceback
 
-    # Unsharded single-device run: params and caches live on the default
-    # device, so throughput is per-chip by construction regardless of how many
-    # chips the host exposes.
-    n_chips = 1
-    cfg = get_preset("tinyllama-1.1b")
-
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    ck, cv = init_kv_cache(cfg, BATCH, CAPACITY)
-
-    ids = jax.random.randint(
-        jax.random.PRNGKey(1), (BATCH, PREFILL_LEN), 0, cfg.vocab_size
-    )
-    lens = jnp.full((BATCH,), PREFILL_LEN, jnp.int32)
-    logits, ck, cv = prefill(params, cfg, ids, lens, ck, cv)
-
-    temp = jnp.full((BATCH,), 0.7, jnp.float32)
-    top_p = jnp.full((BATCH,), 0.95, jnp.float32)
-    top_k = jnp.zeros((BATCH,), jnp.int32)
-    key = jax.random.PRNGKey(2)
-
-    def step(carry):
-        logits, ck, cv, seq_lens, key = carry
-        key, sk = jax.random.split(key)
-        tokens = sample_tokens(logits, sk, temp, top_p, top_k)
-        logits, ck, cv = decode_step(params, cfg, tokens, seq_lens, ck, cv)
-        return logits, ck, cv, seq_lens + 1, key
-
-    carry = (logits, ck, cv, lens, key)
-    for _ in range(WARMUP_STEPS):
-        carry = step(carry)
-    carry[0].block_until_ready()
-
-    start = time.perf_counter()
-    for _ in range(DECODE_STEPS):
-        carry = step(carry)
-    carry[0].block_until_ready()
-    elapsed = time.perf_counter() - start
-
-    toks_per_sec = BATCH * DECODE_STEPS / elapsed
-    per_chip = toks_per_sec / max(n_chips, 1)
-    print(
-        json.dumps(
-            {
-                "metric": "decode_tokens_per_sec_per_chip_1b_bf16_batch32",
-                "value": round(per_chip, 2),
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "engine_decode_tokens_per_sec_per_chip",
+                "value": 0.0,
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(per_chip / A100_CLASS_TOKS_PER_SEC, 4),
-            }
-        )
-    )
+                "vs_baseline": 0.0,
+                "platform": "tpu",
+                "error": f"{type(e).__name__}: {e}",
+            }))
+            return
+    else:
+        log(f"TPU unavailable ({diag}); falling back to CPU diagnostic run")
+        # Force the CPU backend BEFORE jax initializes; the axon sitecustomize
+        # overrides JAX_PLATFORMS, so use the config API which it honours.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            result = run_engine_bench("cpu")
+        except Exception as e:  # keep the contract: one JSON line, always
+            print(json.dumps({
+                "metric": "engine_decode_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": 0.0,
+                "platform": "none",
+                "error": f"{type(e).__name__}: {e}",
+                "tpu_probe_error": diag,
+            }))
+            return
+        result["tpu_probe_error"] = diag
+        result["vs_baseline"] = 0.0  # CPU number is a smoke value, not a claim
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

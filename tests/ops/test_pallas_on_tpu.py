@@ -1,0 +1,96 @@
+"""On-hardware Pallas smoke tests (VERDICT r1 item 2).
+
+These run the flash kernels NON-interpreted — a real Mosaic compile + execute
+on the TPU — and compare against the XLA einsum baselines. Skipped anywhere
+but a live TPU backend; the interpret-mode numerics live in
+test_pallas_attention.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmlb_tpu.ops.attention import gqa_attention_decode, gqa_attention_prefill
+from llmlb_tpu.ops.pallas_attention import flash_decode, flash_prefill
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="requires a live TPU backend (Mosaic compile)",
+)
+
+
+@pytest.fixture(autouse=True)
+def _pin_baseline_to_xla(monkeypatch):
+    monkeypatch.setenv("LLMLB_TPU_ATTENTION", "xla")
+
+
+def _rand(key, shape, dtype=jnp.bfloat16):
+    return jax.random.normal(key, shape, jnp.float32).astype(dtype)
+
+
+def test_flash_decode_compiles_and_matches_on_tpu():
+    b, h, kv, d, s = 8, 32, 4, 64, 1024  # tinyllama-1.1b serving shape
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = _rand(keys[0], (b, 1, h, d))
+    k_cache = _rand(keys[1], (b, s, kv, d))
+    v_cache = _rand(keys[2], (b, s, kv, d))
+    kv_lens = jax.random.randint(keys[3], (b,), 1, s + 1, jnp.int32)
+
+    expected = gqa_attention_decode(q, k_cache, v_cache, kv_lens)
+    got = flash_decode(q[:, 0], k_cache, v_cache, kv_lens, interpret=False)
+    got.block_until_ready()  # force the Mosaic executable to actually run
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expected[:, 0], np.float32),
+        rtol=2e-2, atol=2e-2,  # bf16 inputs, fp32 accumulation
+    )
+
+
+def test_flash_prefill_compiles_and_matches_on_tpu():
+    b, t, h, kv, d = 2, 512, 32, 4, 64
+    keys = jax.random.split(jax.random.PRNGKey(1), 4)
+    q = _rand(keys[0], (b, t, h, d))
+    k = _rand(keys[1], (b, t, kv, d))
+    v = _rand(keys[2], (b, t, kv, d))
+    prompt_lens = jnp.asarray([t, t // 2 + 3], jnp.int32)
+
+    expected = gqa_attention_prefill(q, k, v, prompt_lens)
+    got = flash_prefill(q, k, v, prompt_lens, interpret=False)
+    got.block_until_ready()
+    # compare only valid tokens (padding rows are don't-care)
+    for i, n in enumerate(np.asarray(prompt_lens)):
+        np.testing.assert_allclose(
+            np.asarray(got[i, :n], np.float32),
+            np.asarray(expected[i, :n], np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+def test_engine_decode_through_pallas_on_tpu(monkeypatch):
+    """The serving dispatch (ops/attention.py) must run Pallas kernels through
+    a real model prefill + decode step and produce finite logits.
+
+    Uses a config whose shapes no other test shares: jax.jit caches
+    executables keyed on shapes + static cfg (not the env var), so a unique
+    cfg guarantees this test really traces — and therefore Mosaic-compiles —
+    the Pallas path rather than reusing a cached XLA executable.
+    """
+    from llmlb_tpu.models import llama
+    from llmlb_tpu.models.llama import LlamaConfig
+
+    monkeypatch.setenv("LLMLB_TPU_ATTENTION", "pallas")
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=96, intermediate_size=192,
+        num_layers=2, num_heads=6, num_kv_heads=2, dtype=jnp.float32,
+        max_position_embeddings=256,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ck, cv = llama.init_kv_cache(cfg, 3, 48)
+    ids = jnp.zeros((3, 24), jnp.int32)
+    lens = jnp.asarray([5, 9, 24], jnp.int32)
+    logits, ck, cv = llama.prefill(params, cfg, ids, lens, ck, cv)
+    logits2, _, _ = llama.decode_step(
+        params, cfg, jnp.asarray([1, 2, 3], jnp.int32), lens, ck, cv
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+    assert np.isfinite(np.asarray(logits2)).all()
