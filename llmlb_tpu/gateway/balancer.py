@@ -10,8 +10,12 @@ Behavior parity with the reference scheduler (reference balancer/mod.rs):
   the holder forgets (balancer/lease.rs Drop semantics).
 - 60-minute in-memory request history ring for dashboards (types.rs:22),
   seeded from the DB at boot.
-- TPU-aware extension (no reference counterpart): scores can be biased by
-  accelerator telemetry (free HBM) from the health checker.
+- TPU-aware extension (no reference counterpart): measured TPS scores are
+  multiplied by a telemetry penalty computed from the endpoint's last health
+  probe — HBM pressure above HBM_PRESSURE_KNEE fades the score toward zero,
+  and a non-empty engine admission queue divides it by (1 + depth). Unmeasured
+  endpoints still probe first, but telemetry breaks ties among them before
+  round-robin does.
 """
 
 from __future__ import annotations
@@ -27,6 +31,36 @@ from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
 TPS_EMA_ALPHA = 0.2  # parity: balancer/types.rs:109
 HISTORY_WINDOW_S = 3600.0  # parity: 60-min window, balancer/types.rs:22
 METRICS_STALE_S = 120.0
+
+# Telemetry-aware placement: above this HBM fill fraction an endpoint's score
+# fades linearly, reaching TELEMETRY_MIN_PENALTY at 100% full. A KV-cache-bound
+# engine near HBM capacity will soon reject or thrash; prefer its peers.
+HBM_PRESSURE_KNEE = 0.85
+TELEMETRY_MIN_PENALTY = 0.05
+
+
+def telemetry_penalty(ep: Endpoint, now: float | None = None) -> float:
+    """Multiplicative demotion factor in (0, 1] from the endpoint's last
+    health-probe telemetry. 1.0 = unloaded, no telemetry, or telemetry older
+    than METRICS_STALE_S (a snapshot from a probe that has since stopped
+    reporting must not demote an endpoint forever)."""
+    acc = ep.accelerator
+    if acc is None:
+        return 1.0
+    if acc.sampled_at <= 0:
+        return 1.0
+    if ((now if now is not None else time.time()) - acc.sampled_at
+            > METRICS_STALE_S):
+        return 1.0
+    p = 1.0
+    pressure = acc.hbm_pressure
+    if pressure is not None and pressure > HBM_PRESSURE_KNEE:
+        span = 1.0 - HBM_PRESSURE_KNEE
+        frac = min(1.0, (pressure - HBM_PRESSURE_KNEE) / span)
+        p *= max(TELEMETRY_MIN_PENALTY, 1.0 - frac * (1.0 - TELEMETRY_MIN_PENALTY))
+    if acc.queue_depth > 0:
+        p /= 1.0 + acc.queue_depth
+    return p
 
 
 @dataclasses.dataclass
@@ -165,8 +199,9 @@ class LoadManager:
         model: str,
         api_kind: TpsApiKind = TpsApiKind.CHAT,
     ) -> Endpoint | None:
-        """Pick the best endpoint: measured-TPS desc; unmeasured first (probe),
-        round-robin among equals; full endpoints (admission cap) excluded."""
+        """Pick the best endpoint: telemetry-weighted measured-TPS desc;
+        unmeasured first (probe), telemetry then round-robin among equals;
+        full endpoints (admission cap) excluded."""
         if not endpoints:
             return None
         cap = self.queue_config.max_active_per_endpoint
@@ -177,17 +212,27 @@ class LoadManager:
             if not candidates:
                 return None
 
-            def score(ep: Endpoint) -> float:
+            now = time.time()
+            scored: list[tuple[float, float, Endpoint]] = []
+            for ep in candidates:
+                pen = telemetry_penalty(ep, now)
                 state = self._tps.get((ep.id, model, api_kind.value))
                 if state is None or state.samples == 0:
-                    return float("inf")  # unmeasured: probe first
-                return state.ema_tps
+                    s = float("inf")  # unmeasured: probe first
+                else:
+                    s = state.ema_tps * pen
+                scored.append((s, pen, ep))
 
-            best = max(score(ep) for ep in candidates)
-            top = [ep for ep in candidates if score(ep) == best]
+            best = max(s for s, _, _ in scored)
+            top = [(pen, ep) for s, pen, ep in scored if s == best]
+            if len(top) > 1:
+                # inf ties (all unmeasured) and exact-score ties: let telemetry
+                # discriminate before falling back to round-robin.
+                best_pen = max(pen for pen, _ in top)
+                top = [(pen, ep) for pen, ep in top if pen == best_pen]
             idx = self._rr_counter[model] % len(top)
             self._rr_counter[model] += 1
-            return top[idx]
+            return top[idx][1]
 
     def begin_request(
         self, endpoint: Endpoint, model: str, api_kind: TpsApiKind

@@ -37,6 +37,35 @@ log = logging.getLogger("llmlb_tpu.gateway.health")
 OFFLINE_AFTER_FAILURES = 2  # parity: endpoint_checker.rs:46
 
 
+def _as_int(v, default: int = 0) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def _parse_telemetry(body: dict) -> AcceleratorInfo:
+    """Tolerant parse of an engine /api/health body. Malformed fields degrade
+    to zeros rather than raising — a bad payload from one endpoint must never
+    abort the whole health cycle (check_all gathers without return_exceptions)."""
+    tpu = body.get("tpu") or body.get("gpu")
+    tpu = tpu if isinstance(tpu, dict) else {}
+    engine = body.get("engine")
+    engine = engine if isinstance(engine, dict) else {}
+    util = tpu.get("utilization")
+    return AcceleratorInfo(
+        accelerator=tpu.get("accelerator") or ("tpu" if "tpu" in body else None),
+        chip_count=_as_int(tpu.get("chip_count")),
+        hbm_used_bytes=_as_int(tpu.get("hbm_used_bytes")),
+        hbm_total_bytes=_as_int(tpu.get("hbm_total_bytes")),
+        utilization=util if isinstance(util, (int, float)) else None,
+        queue_depth=_as_int(engine.get("queued")),
+        active_slots=_as_int(engine.get("active_slots")),
+        num_slots=_as_int(engine.get("num_slots")),
+        sampled_at=time.time(),
+    )
+
+
 class EndpointHealthChecker:
     def __init__(
         self,
@@ -115,15 +144,7 @@ class EndpointHealthChecker:
                 except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                     status, body = 0, None
                 if status == 200 and body:
-                    tpu = body.get("tpu") or body.get("gpu") or {}
-                    accelerator = AcceleratorInfo(
-                        accelerator=tpu.get("accelerator")
-                        or ("tpu" if "tpu" in body else None),
-                        chip_count=int(tpu.get("chip_count", 0)),
-                        hbm_used_bytes=int(tpu.get("hbm_used_bytes", 0)),
-                        hbm_total_bytes=int(tpu.get("hbm_total_bytes", 0)),
-                        utilization=tpu.get("utilization"),
-                    )
+                    accelerator = _parse_telemetry(body)
                 else:
                     status, models_payload = await get("/v1/models")
             else:

@@ -70,13 +70,30 @@ class TpsApiKind(str, enum.Enum):
 
 @dataclasses.dataclass
 class AcceleratorInfo:
-    """Chip/HBM telemetry reported by an endpoint's health probe."""
+    """Chip/HBM + engine-load telemetry reported by an endpoint's health probe.
+
+    The scheduler (balancer.select_endpoint) folds these into placement:
+    HBM pressure and engine queue depth demote an endpoint relative to its
+    measured TPS (the reference read GPU fields for display only,
+    health/endpoint_checker.rs:515 — acting on them is a TPU-native extension).
+    """
 
     accelerator: str | None = None  # "tpu" | "gpu" | ...
     chip_count: int = 0
     hbm_used_bytes: int = 0
     hbm_total_bytes: int = 0
     utilization: float | None = None
+    # Engine load figures (tpu:// engines report these in /api/health):
+    queue_depth: int = 0  # requests waiting for a slot
+    active_slots: int = 0
+    num_slots: int = 0
+    sampled_at: float = 0.0  # when the probe captured this; 0 = never
+
+    @property
+    def hbm_pressure(self) -> float | None:
+        if self.hbm_total_bytes <= 0:
+            return None
+        return self.hbm_used_bytes / self.hbm_total_bytes
 
 
 @dataclasses.dataclass

@@ -4,13 +4,16 @@ import gc
 import time
 
 from llmlb_tpu.gateway.balancer import (
+    METRICS_STALE_S,
+    TELEMETRY_MIN_PENALTY,
     TPS_EMA_ALPHA,
     LoadManager,
     ModelTpsState,
     RequestRecord,
+    telemetry_penalty,
 )
 from llmlb_tpu.gateway.config import QueueConfig
-from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
+from llmlb_tpu.gateway.types import AcceleratorInfo, Endpoint, TpsApiKind
 
 
 def ep(name: str) -> Endpoint:
@@ -108,3 +111,77 @@ def test_history_minute_buckets():
     assert sum(b["requests"] for b in buckets) == 5
     assert sum(b["errors"] for b in buckets) == 2
     assert sum(b["completion_tokens"] for b in buckets) == 35
+
+# ---------------------------------------------------- telemetry-aware placement
+
+def tpu_ep(name: str, *, hbm_used=0, hbm_total=0, queued=0) -> Endpoint:
+    e = ep(name)
+    e.accelerator = AcceleratorInfo(
+        accelerator="tpu", chip_count=1,
+        hbm_used_bytes=hbm_used, hbm_total_bytes=hbm_total,
+        queue_depth=queued, num_slots=8, sampled_at=time.time(),
+    )
+    return e
+
+
+def test_telemetry_penalty_shape():
+    assert telemetry_penalty(ep("plain")) == 1.0  # no telemetry -> neutral
+    low = tpu_ep("low", hbm_used=50, hbm_total=100)
+    assert telemetry_penalty(low) == 1.0  # below the knee -> neutral
+    hot = tpu_ep("hot", hbm_used=99, hbm_total=100)
+    assert telemetry_penalty(hot) < 0.15
+    full = tpu_ep("full", hbm_used=100, hbm_total=100)
+    assert abs(telemetry_penalty(full) - TELEMETRY_MIN_PENALTY) < 1e-9
+    queued = tpu_ep("queued", queued=3)
+    assert abs(telemetry_penalty(queued) - 0.25) < 1e-9
+
+
+def test_hbm_pressured_endpoint_deprioritized():
+    """Two TPU endpoints, equal measured TPS; the HBM-pressured one loses."""
+    lm = LoadManager()
+    calm = tpu_ep("calm", hbm_used=40, hbm_total=100)
+    hot = tpu_ep("hot", hbm_used=97, hbm_total=100)
+    for e in (calm, hot):
+        lm.update_tps(e.id, "m", TpsApiKind.CHAT, 200, 1.0)
+    for _ in range(4):
+        assert lm.select_endpoint([hot, calm], "m") is calm
+
+
+def test_engine_queue_depth_deprioritized():
+    lm = LoadManager()
+    idle = tpu_ep("idle")
+    backed_up = tpu_ep("backed", queued=5)
+    for e in (idle, backed_up):
+        lm.update_tps(e.id, "m", TpsApiKind.CHAT, 200, 1.0)
+    for _ in range(4):
+        assert lm.select_endpoint([backed_up, idle], "m") is idle
+
+
+def test_unmeasured_tie_broken_by_telemetry_then_rr():
+    lm = LoadManager()
+    hot = tpu_ep("hot", hbm_used=99, hbm_total=100)
+    a, b = tpu_ep("a"), tpu_ep("b")
+    # all unmeasured (inf): the pressured one must not be probed first
+    picks = [lm.select_endpoint([hot, a, b], "m").name for _ in range(4)]
+    assert "hot" not in picks
+    assert picks == ["a", "b", "a", "b"]  # RR among the healthy pair
+
+
+def test_telemetry_does_not_flip_large_tps_gap():
+    """A mildly queued endpoint that is 10x faster still wins."""
+    lm = LoadManager()
+    fast = tpu_ep("fast", queued=1)      # penalty 0.5
+    slow = tpu_ep("slow")
+    lm.update_tps(fast.id, "m", TpsApiKind.CHAT, 1000, 1.0)
+    lm.update_tps(slow.id, "m", TpsApiKind.CHAT, 100, 1.0)
+    assert lm.select_endpoint([fast, slow], "m") is fast
+
+
+def test_stale_telemetry_is_ignored():
+    """A snapshot older than METRICS_STALE_S must not demote an endpoint."""
+    stale = tpu_ep("stale", hbm_used=99, hbm_total=100, queued=9)
+    stale.accelerator.sampled_at = time.time() - METRICS_STALE_S - 1
+    assert telemetry_penalty(stale) == 1.0
+    never = tpu_ep("never", hbm_used=99, hbm_total=100)
+    never.accelerator.sampled_at = 0.0  # never sampled (e.g. built from DB row)
+    assert telemetry_penalty(never) == 1.0
