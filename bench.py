@@ -37,47 +37,98 @@ _PEAK_FLOPS = {
 }
 
 PROBE_TIMEOUT_S = 150
-PROBE_RETRIES = 2
+PROBE_LONG_TIMEOUT_S = 420  # init over a tunnel can legitimately take minutes
+
+# The staged probe runs in a child with faulthandler stack dumps every 30s, so
+# a hang reports WHERE it hangs (e.g. jaxlib make_c_api_client waiting on the
+# PJRT plugin's device claim) instead of just "timed out".
+_PROBE_CODE = r"""
+import faulthandler, sys, time
+faulthandler.enable()
+faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
+t0 = time.time()
+def mark(stage):
+    print(f"[probe +{time.time()-t0:.1f}s] {stage}", file=sys.stderr, flush=True)
+mark("stage1: import jax")
+import jax
+mark(f"stage1 done: jax {jax.__version__}")
+mark("stage2: jax.devices() (backend init)")
+d = jax.devices()
+mark(f"stage2 done: {len(d)}x {getattr(d[0], 'device_kind', '?')}")
+mark("stage3: tiny matmul")
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+mark("stage3 done")
+print(jax.default_backend(), len(d), getattr(d[0], 'device_kind', '?'))
+"""
 
 
 def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def probe_tpu() -> tuple[bool, str]:
+def _tail(text: str | bytes | None, lines: int = 25) -> list[str]:
+    if not text:
+        return []
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    return [ln[:300] for ln in text.strip().splitlines()[-lines:]]
+
+
+def probe_tpu() -> tuple[bool, str, dict]:
     """Check TPU backend health in a subprocess so a hung init can't wedge the
-    bench. Returns (ok, diagnostic)."""
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "d = jax.devices()\n"
-        "x = jnp.ones((256, 256), jnp.bfloat16)\n"
-        "(x @ x).block_until_ready()\n"
-        "print(jax.default_backend(), len(d), getattr(d[0], 'device_kind', '?'))\n"
-    )
+    bench. Staged (import → device enum → matmul) with periodic stack dumps;
+    on timeout the child's captured stderr is preserved as evidence. Two short
+    attempts, then one long one. Returns (ok, diagnostic, evidence)."""
+    env = dict(os.environ)
+    # Verbose init logging from libtpu/PJRT so a hang leaves a trail.
+    env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    env.setdefault("TPU_MIN_LOG_LEVEL", "0")
+    env.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
+
+    evidence: dict = {"attempts": []}
     last = ""
-    for attempt in range(1, PROBE_RETRIES + 1):
-        log(f"TPU probe attempt {attempt}/{PROBE_RETRIES} "
-            f"(timeout {PROBE_TIMEOUT_S}s)")
+    # One short attempt, then one long one — init over a tunnel can take
+    # minutes, and every hang leaves staged stack evidence either way.
+    timeouts = [PROBE_TIMEOUT_S, PROBE_LONG_TIMEOUT_S]
+    for attempt, timeout_s in enumerate(timeouts, start=1):
+        log(f"TPU probe attempt {attempt}/{len(timeouts)} (timeout {timeout_s}s)")
+        rec: dict = {"attempt": attempt, "timeout_s": timeout_s}
         try:
             r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
             )
-        except subprocess.TimeoutExpired:
-            last = f"probe timed out after {PROBE_TIMEOUT_S}s (backend init hang)"
+        except subprocess.TimeoutExpired as te:
+            # TimeoutExpired carries the child's output so far — keep it.
+            rec["outcome"] = f"timeout after {timeout_s}s"
+            rec["child_stderr_tail"] = _tail(te.stderr)
+            rec["child_stdout_tail"] = _tail(te.stdout)
+            evidence["attempts"].append(rec)
+            last = f"probe timed out after {timeout_s}s (backend init hang)"
             log(last)
+            for ln in rec["child_stderr_tail"]:
+                log(f"  child| {ln}")
             continue
+        rec["returncode"] = r.returncode
         if r.returncode == 0 and r.stdout.strip():
             out = r.stdout.strip().splitlines()[-1]
             log(f"TPU probe OK: {out}")
+            rec["outcome"] = f"ok: {out}"
+            evidence["attempts"].append(rec)
             if out.startswith(("tpu", "axon")):
-                return True, out
+                return True, out, evidence
             last = f"backend is {out!r}, not tpu"
-            return False, last
-        last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["unknown"]
-        last = f"probe rc={r.returncode}: {last[0][:300]}"
+            return False, last, evidence
+        rec["outcome"] = f"rc={r.returncode}"
+        rec["child_stderr_tail"] = _tail(r.stderr)
+        rec["child_stdout_tail"] = _tail(r.stdout)
+        evidence["attempts"].append(rec)
+        tail = rec["child_stderr_tail"] or rec["child_stdout_tail"] or ["unknown"]
+        last = f"probe rc={r.returncode}: {tail[-1]}"
         log(last)
-    return False, last
+    return False, last, evidence
 
 
 def run_engine_bench(platform: str) -> dict:
@@ -209,7 +260,7 @@ def run_engine_bench(platform: str) -> dict:
 
 
 def main() -> None:
-    ok, diag = probe_tpu()
+    ok, diag, evidence = probe_tpu()
     if ok:
         try:
             result = run_engine_bench("tpu")
@@ -244,9 +295,11 @@ def main() -> None:
                 "platform": "none",
                 "error": f"{type(e).__name__}: {e}",
                 "tpu_probe_error": diag,
+                "tpu_probe_evidence": evidence,
             }))
             return
         result["tpu_probe_error"] = diag
+        result["tpu_probe_evidence"] = evidence
         result["vs_baseline"] = 0.0  # CPU number is a smoke value, not a claim
     print(json.dumps(result))
 
