@@ -363,7 +363,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
         return _anthropic_error(
             404, f"model {model!r} is not available", "not_found_error"
         )
-    endpoint, engine_model = selection
+    endpoint, engine_model, lease = selection
     openai_body["model"] = engine_model
     is_stream = bool(body.get("stream"))
     if is_stream:
@@ -373,7 +373,6 @@ async def messages(request: web.Request) -> web.StreamResponse:
     headers = {"Content-Type": "application/json"}
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"
-    lease = state.load_manager.begin_request(endpoint, canonical, TpsApiKind.CHAT)
     try:
         upstream = await state.http.post(
             endpoint.url + "/v1/chat/completions",

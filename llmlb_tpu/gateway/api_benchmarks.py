@@ -55,8 +55,7 @@ async def _run_single(state, model: str, prompt: str, max_tokens: int) -> dict:
         selection = None
     if selection is None:
         return {"ok": False, "error": "no endpoint", "endpoint_id": None}
-    endpoint, engine_model = selection
-    lease = state.load_manager.begin_request(endpoint, model, TpsApiKind.CHAT)
+    endpoint, engine_model, lease = selection
     headers = {}
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"

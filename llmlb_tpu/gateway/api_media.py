@@ -14,28 +14,46 @@ import time
 import aiohttp
 from aiohttp import web
 
-from llmlb_tpu.gateway.api_openai import _record, error_response
+from llmlb_tpu.gateway.api_openai import (
+    QueueTimeout,
+    _record,
+    error_response,
+)
 from llmlb_tpu.gateway.types import Capability, TpsApiKind
 
 
-def _select_by_capability(state, capability: Capability, model: str | None):
+def _capability_pairs(state, capability: Capability, model: str | None):
     pairs = state.registry.list_online_by_capability(capability)
     if model:
-        filtered = [
+        pairs = [
             (ep, m) for ep, m in pairs
             if m.canonical_name == model or m.model_id == model
         ]
-        pairs = filtered or []
-    if not pairs:
+    return pairs
+
+
+async def _admit_by_capability(state, capability: Capability,
+                               model: str | None):
+    """Atomic admission on the capability-filtered pool; parks on the
+    AdmissionQueue (same machinery as /v1/chat) when all slots are taken."""
+    if not _capability_pairs(state, capability, model):
         return None
-    endpoints = [ep for ep, _ in pairs]
-    chosen = state.load_manager.select_endpoint(
-        endpoints, model or capability.value, TpsApiKind.OTHER
+    schedule_key = model or capability.value
+
+    def get_endpoints():
+        return [ep for ep, _ in _capability_pairs(state, capability, model)]
+
+    result = await state.admission.admit(
+        get_endpoints, schedule_key, TpsApiKind.OTHER
     )
-    if chosen is None:
-        return None
-    engine_model = next(m.model_id for ep, m in pairs if ep.id == chosen.id)
-    return chosen, engine_model
+    if not result.admitted:
+        raise QueueTimeout(result.queue_position, result.waited_s)
+    pairs = _capability_pairs(state, capability, model)
+    engine_model = next(
+        (m.model_id for ep, m in pairs if ep.id == result.endpoint.id),
+        model or "",
+    )
+    return result.endpoint, engine_model, result.lease
 
 
 async def _reproxy_multipart(
@@ -96,17 +114,22 @@ async def _media_proxy(
         if not (request.content_type or "").startswith("multipart/"):
             return error_response(400, "multipart/form-data body required")
 
-    selection = _select_by_capability(state, capability, model)
+    try:
+        selection = await _admit_by_capability(state, capability, model)
+    except QueueTimeout as qt:
+        return error_response(
+            503,
+            f"all endpoints busy; queue timeout exceeded "
+            f"(position {qt.queue_position})",
+            "server_error",
+        )
     if selection is None:
         return error_response(
             404,
             f"no online endpoint provides capability {capability.value!r}"
             + (f" for model {model!r}" if model else ""),
         )
-    endpoint, engine_model = selection
-    lease = state.load_manager.begin_request(
-        endpoint, model or capability.value, TpsApiKind.OTHER
-    )
+    endpoint, engine_model, lease = selection
     try:
         if multipart:
             resp = await _reproxy_multipart(

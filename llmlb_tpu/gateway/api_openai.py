@@ -52,28 +52,34 @@ def parse_cloud_prefix(model: str) -> tuple[str | None, str]:
 
 async def select_endpoint_with_queue(
     state: AppState, model: str, capability: Capability, api_kind: TpsApiKind
-) -> tuple[Endpoint, str] | None:
-    """TPS-select among online endpoints serving the model; if all are at the
-    admission cap, wait up to queue_timeout for a free slot (queueing parity)."""
-    deadline = time.monotonic() + state.load_manager.queue_config.queue_timeout_s
-    while True:
-        pairs = state.registry.find_by_model(model, capability)
-        if not pairs:
-            return None
-        endpoints = [ep for ep, _ in pairs]
-        chosen = state.load_manager.select_endpoint(endpoints, model, api_kind)
-        if chosen is not None:
-            engine_model = next(
-                m.model_id for ep, m in pairs if ep.id == chosen.id
-            )
-            return chosen, engine_model
-        if time.monotonic() >= deadline:
-            raise QueueTimeout()
-        await asyncio.sleep(0.05)
+) -> tuple[Endpoint, str, "RequestLease"] | None:
+    """Atomically TPS-select and lease an endpoint serving the model; if all
+    are at the admission cap, park on the AdmissionQueue until a lease release
+    wakes us or the queue timeout passes (notify-based, no polling — parity:
+    balancer/mod.rs:2273-2427)."""
+    if not state.registry.find_by_model(model, capability):
+        return None
+
+    def get_endpoints() -> list[Endpoint]:
+        return [ep for ep, _ in state.registry.find_by_model(model, capability)]
+
+    result = await state.admission.admit(get_endpoints, model, api_kind)
+    if not result.admitted:
+        raise QueueTimeout(result.queue_position, result.waited_s)
+    pairs = state.registry.find_by_model(model, capability)
+    engine_model = next(
+        (m.model_id for ep, m in pairs if ep.id == result.endpoint.id),
+        model,
+    )
+    return result.endpoint, engine_model, result.lease
 
 
 class QueueTimeout(Exception):
-    pass
+    def __init__(self, queue_position: int = 0, waited_s: float = 0.0):
+        super().__init__(f"queue timeout at position {queue_position} "
+                         f"after {waited_s:.1f}s")
+        self.queue_position = queue_position
+        self.waited_s = waited_s
 
 
 def _record(
@@ -143,16 +149,19 @@ async def proxy_openai_post(
         selection = await select_endpoint_with_queue(
             state, canonical, capability, api_kind
         )
-    except QueueTimeout:
+    except QueueTimeout as qt:
         return error_response(
-            503, "all endpoints busy; queue timeout exceeded", "server_error"
+            503,
+            f"all endpoints busy; queue timeout exceeded "
+            f"(position {qt.queue_position})",
+            "server_error",
         )
     if selection is None:
         return error_response(
             404, f"model {model!r} is not available on any online endpoint",
             "invalid_request_error",
         )
-    endpoint, engine_model = selection
+    endpoint, engine_model, lease = selection
 
     payload = dict(body)
     # registry knows the engine-local name; fall back to the static alias table
@@ -170,7 +179,6 @@ async def proxy_openai_post(
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"
 
-    lease = state.load_manager.begin_request(endpoint, canonical, api_kind)
     client_ip = request.remote
     auth = request.get("auth")
     prompt_text = prompt_text_fn(body) if prompt_text_fn else ""

@@ -24,7 +24,7 @@ from llmlb_tpu.gateway.auth import (
     UserStore,
     ensure_admin_exists,
 )
-from llmlb_tpu.gateway.balancer import LoadManager
+from llmlb_tpu.gateway.balancer import AdmissionQueue, LoadManager
 from llmlb_tpu.gateway.config import QueueConfig, ServerConfig
 from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
@@ -42,6 +42,7 @@ class AppState:
     db: Database
     registry: EndpointRegistry
     load_manager: LoadManager
+    admission: AdmissionQueue
     events: DashboardEventBus
     gate: InferenceGate
     audit: AuditLog
@@ -82,6 +83,7 @@ async def build_app_state(
 
     registry = EndpointRegistry(db)
     load_manager = LoadManager(QueueConfig.from_env())
+    admission = AdmissionQueue(load_manager)
     events = DashboardEventBus()
     gate = InferenceGate()
     audit = AuditLog(db)
@@ -118,7 +120,7 @@ async def build_app_state(
 
     state = AppState(
         config=config, db=db, registry=registry, load_manager=load_manager,
-        events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
+        admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
     )
 

@@ -20,9 +20,11 @@ Behavior parity with the reference scheduler (reference balancer/mod.rs):
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import threading
 import time
+import typing
 from collections import defaultdict, deque
 
 from llmlb_tpu.gateway.config import QueueConfig
@@ -147,6 +149,10 @@ class LoadManager:
         self._rr_counter: dict[str, int] = defaultdict(int)  # round-robin per model
         self._history: deque[RequestRecord] = deque()
         self._total_requests = 0
+        # Called (outside the lock) with the endpoint id each time a lease is
+        # released — the AdmissionQueue uses it to wake parked waiters instead
+        # of having them poll (parity: balancer/mod.rs:2273-2427 notify path).
+        self.on_release: typing.Callable[[str], None] | None = None
 
     # ------------------------------------------------------------------- TPS
 
@@ -204,35 +210,56 @@ class LoadManager:
         full endpoints (admission cap) excluded."""
         if not endpoints:
             return None
-        cap = self.queue_config.max_active_per_endpoint
         with self._lock:
-            candidates = [
-                ep for ep in endpoints if self._active[ep.id] < cap
-            ]
-            if not candidates:
+            return self._select_locked(endpoints, model, api_kind)
+
+    def _select_locked(
+        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind
+    ) -> Endpoint | None:
+        cap = self.queue_config.max_active_per_endpoint
+        candidates = [
+            ep for ep in endpoints if self._active[ep.id] < cap
+        ]
+        if not candidates:
+            return None
+
+        now = time.time()
+        scored: list[tuple[float, float, Endpoint]] = []
+        for ep in candidates:
+            pen = telemetry_penalty(ep, now)
+            state = self._tps.get((ep.id, model, api_kind.value))
+            if state is None or state.samples == 0:
+                s = float("inf")  # unmeasured: probe first
+            else:
+                s = state.ema_tps * pen
+            scored.append((s, pen, ep))
+
+        best = max(s for s, _, _ in scored)
+        top = [(pen, ep) for s, pen, ep in scored if s == best]
+        if len(top) > 1:
+            # inf ties (all unmeasured) and exact-score ties: let telemetry
+            # discriminate before falling back to round-robin.
+            best_pen = max(pen for pen, _ in top)
+            top = [(pen, ep) for pen, ep in top if pen == best_pen]
+        idx = self._rr_counter[model] % len(top)
+        self._rr_counter[model] += 1
+        return top[idx][1]
+
+    def try_admit(
+        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind
+    ) -> tuple[Endpoint, RequestLease] | None:
+        """Atomic select + lease under one lock: concurrent admissions cannot
+        both pick the last free slot of an endpoint (the select-then-begin
+        two-step had that race)."""
+        if not endpoints:
+            return None
+        with self._lock:
+            chosen = self._select_locked(endpoints, model, api_kind)
+            if chosen is None:
                 return None
-
-            now = time.time()
-            scored: list[tuple[float, float, Endpoint]] = []
-            for ep in candidates:
-                pen = telemetry_penalty(ep, now)
-                state = self._tps.get((ep.id, model, api_kind.value))
-                if state is None or state.samples == 0:
-                    s = float("inf")  # unmeasured: probe first
-                else:
-                    s = state.ema_tps * pen
-                scored.append((s, pen, ep))
-
-            best = max(s for s, _, _ in scored)
-            top = [(pen, ep) for s, pen, ep in scored if s == best]
-            if len(top) > 1:
-                # inf ties (all unmeasured) and exact-score ties: let telemetry
-                # discriminate before falling back to round-robin.
-                best_pen = max(pen for pen, _ in top)
-                top = [(pen, ep) for pen, ep in top if pen == best_pen]
-            idx = self._rr_counter[model] % len(top)
-            self._rr_counter[model] += 1
-            return top[idx][1]
+            self._active[chosen.id] += 1
+            self._total_requests += 1
+        return chosen, RequestLease(self, chosen.id, model, api_kind)
 
     def begin_request(
         self, endpoint: Endpoint, model: str, api_kind: TpsApiKind
@@ -246,6 +273,12 @@ class LoadManager:
         with self._lock:
             if self._active[endpoint_id] > 0:
                 self._active[endpoint_id] -= 1
+        cb = self.on_release
+        if cb is not None:
+            try:
+                cb(endpoint_id)
+            except Exception:  # a broken listener must not poison releases
+                pass
 
     def active_count(self, endpoint_id: str) -> int:
         with self._lock:
@@ -290,3 +323,135 @@ class LoadManager:
                 "history_size": len(self._history),
                 "tracked_tps_keys": len(self._tps),
             }
+
+
+@dataclasses.dataclass
+class WaitResult:
+    """Outcome of a queued admission wait (parity: balancer/types.rs
+    WaitResult / AdmissionDecision)."""
+
+    admitted: bool
+    endpoint: Endpoint | None = None
+    lease: RequestLease | None = None
+    queue_position: int = 0  # 1-based position held while waiting (0 = fast path)
+    waited_s: float = 0.0
+
+
+# Parked waiters re-check capacity at least this often even without a release
+# wake — covers endpoints that register/recover mid-wait (no release fires).
+RECHECK_INTERVAL_S = 1.0
+
+
+class _Ticket:
+    __slots__ = ("future",)
+
+    def __init__(self):
+        self.future: "asyncio.Future | None" = None
+
+
+class AdmissionQueue:
+    """Notify-based admission: waiters park on futures that lease releases
+    wake, replacing a 50 ms poll loop (parity: the reference's notify-based
+    begin_request/WaitResult machinery, balancer/mod.rs:2273-2427).
+
+    FIFO-fair: tickets queue in arrival order; a release wakes every parked
+    waiter (the event loop then runs their retries in queue order, so the
+    oldest waiter gets first claim on the freed slot). Wakes arriving from
+    other threads (e.g. a lease released by a GC finalizer) are marshalled
+    onto the owning event loop with call_soon_threadsafe.
+    """
+
+    def __init__(self, manager: LoadManager):
+        self.manager = manager
+        self._tickets: deque[_Ticket] = deque()
+        self._loop: "asyncio.AbstractEventLoop | None" = None
+        manager.on_release = self._on_release
+
+    # ---------------------------------------------------------------- waking
+
+    def _on_release(self, endpoint_id: str) -> None:
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            self._wake_all()
+        else:
+            try:
+                loop.call_soon_threadsafe(self._wake_all)
+            except RuntimeError:
+                pass  # loop shut down mid-release
+
+    def _wake_all(self) -> None:
+        for t in self._tickets:
+            if t.future is not None and not t.future.done():
+                t.future.set_result(None)
+
+    # --------------------------------------------------------------- waiting
+
+    def position(self, ticket: _Ticket) -> int:
+        try:
+            return self._tickets.index(ticket) + 1
+        except ValueError:
+            return 0
+
+    def queue_depth(self) -> int:
+        return len(self._tickets)
+
+    async def admit(
+        self,
+        get_endpoints,
+        model: str,
+        api_kind: TpsApiKind,
+        timeout_s: float | None = None,
+    ) -> WaitResult:
+        """Admit onto the best endpoint, parking until a slot frees or the
+        queue timeout passes. `get_endpoints` is re-invoked on every retry so
+        registry changes (recovered/added endpoints) are picked up."""
+        start = time.monotonic()
+        got = self.manager.try_admit(get_endpoints(), model, api_kind)
+        if got is not None:
+            return WaitResult(admitted=True, endpoint=got[0], lease=got[1])
+
+        if timeout_s is None:
+            timeout_s = self.manager.queue_config.queue_timeout_s
+        self._loop = asyncio.get_running_loop()
+        deadline = start + timeout_s
+        ticket = _Ticket()
+        self._tickets.append(ticket)
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return WaitResult(
+                        admitted=False,
+                        queue_position=self.position(ticket),
+                        waited_s=time.monotonic() - start,
+                    )
+                ticket.future = self._loop.create_future()
+                try:
+                    # The release notification is the fast path; the bounded
+                    # wait is a slow safety tick so capacity that appears
+                    # WITHOUT a release (an endpoint registering or
+                    # recovering mid-wait) is still noticed promptly.
+                    await asyncio.wait_for(
+                        ticket.future,
+                        timeout=min(remaining, RECHECK_INTERVAL_S),
+                    )
+                except asyncio.TimeoutError:
+                    pass  # fall through to retry; deadline checked at top
+                got = self.manager.try_admit(get_endpoints(), model, api_kind)
+                if got is not None:
+                    return WaitResult(
+                        admitted=True, endpoint=got[0], lease=got[1],
+                        queue_position=self.position(ticket),
+                        waited_s=time.monotonic() - start,
+                    )
+        finally:
+            try:
+                self._tickets.remove(ticket)
+            except ValueError:
+                pass
