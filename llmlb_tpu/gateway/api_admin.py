@@ -8,13 +8,19 @@ audit_log,system}.rs route behavior (SURVEY.md §2.1).
 from __future__ import annotations
 
 import asyncio
+import secrets
 import time
 
 import aiohttp
 from aiohttp import web
 
 from llmlb_tpu import __version__
-from llmlb_tpu.gateway.auth import AuthError, create_jwt
+from llmlb_tpu.gateway.auth import (
+    CSRF_COOKIE,
+    JWT_COOKIE,
+    AuthError,
+    create_jwt,
+)
 from llmlb_tpu.gateway.detection import (
     DetectionError,
     Unreachable,
@@ -237,13 +243,29 @@ async def login(request: web.Request) -> web.Response:
     if user is None:
         return _json_error(401, "invalid credentials")
     token = create_jwt(state.jwt_secret, user.id, user.username, user.role)
-    return web.json_response({
+    resp = web.json_response({
         "token": token,
         "user": {
             "id": user.id, "username": user.username, "role": user.role.value,
             "must_change_password": user.must_change_password,
         },
     })
+    # Cookie session for the dashboard SPA: HttpOnly JWT + a readable CSRF
+    # token for the double-submit check (reference auth/middleware.rs:113-245).
+    csrf = secrets.token_urlsafe(32)
+    secure = request.headers.get("X-Forwarded-Proto", "").lower() == "https"
+    resp.set_cookie(JWT_COOKIE, token, httponly=True, samesite="Lax",
+                    secure=secure, max_age=24 * 3600, path="/")
+    resp.set_cookie(CSRF_COOKIE, csrf, httponly=False, samesite="Lax",
+                    secure=secure, max_age=24 * 3600, path="/")
+    return resp
+
+
+async def logout(request: web.Request) -> web.Response:
+    resp = web.json_response({"ok": True})
+    resp.del_cookie(JWT_COOKIE, path="/")
+    resp.del_cookie(CSRF_COOKIE, path="/")
+    return resp
 
 
 async def me(request: web.Request) -> web.Response:

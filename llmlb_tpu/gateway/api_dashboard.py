@@ -243,3 +243,21 @@ async def _consume_client(ws: web.WebSocketResponse) -> None:
                 break
     except Exception:
         pass
+
+
+async def tail_lb_logs(request: web.Request) -> web.Response:
+    """GET /api/dashboard/logs/lb — tail the gateway's own log file
+    (parity: api/logs.rs:52; requires logs.read via the API-key perm map)."""
+    from llmlb_tpu.gateway.logging_setup import active_log_path, tail_log
+
+    try:
+        lines = int(request.query.get("lines", "200"))
+    except ValueError:
+        return web.json_response({"error": "lines must be an integer"},
+                                 status=400)
+    path = active_log_path()
+    return web.json_response({
+        "path": path,
+        "available": path is not None,
+        "lines": tail_log(lines),
+    })

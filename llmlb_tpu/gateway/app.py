@@ -26,7 +26,12 @@ from llmlb_tpu.gateway import (
 )
 from llmlb_tpu.gateway.app_state import AppState
 from llmlb_tpu.gateway.audit import AuditEntry
-from llmlb_tpu.gateway.auth import AuthError, verify_jwt
+from llmlb_tpu.gateway.auth import (
+    CSRF_COOKIE,
+    JWT_COOKIE,
+    AuthError,
+    verify_jwt,
+)
 from llmlb_tpu.gateway.types import Permission
 
 log = logging.getLogger("llmlb_tpu.gateway.app")
@@ -47,6 +52,7 @@ _API_KEY_PERMS: list[tuple[str, str, Permission]] = [
     ("*", "/api/users", Permission.USERS_MANAGE),
     ("*", "/api/invitations", Permission.INVITATIONS_MANAGE),
     ("GET", "/api/audit", Permission.LOGS_READ),
+    ("GET", "/api/dashboard/logs", Permission.LOGS_READ),
     ("GET", "/api/dashboard", Permission.METRICS_READ),
     ("GET", "/api/metrics", Permission.METRICS_READ),
     ("GET", "/api/models/registry", Permission.REGISTRY_READ),
@@ -118,6 +124,73 @@ def _required_api_key_perm(method: str, path: str) -> Permission | None:
     return None
 
 
+def _origin_matches(request: web.Request) -> bool:
+    """Origin/Referer must match the Host the request arrived on (parity:
+    auth/middleware.rs origin_matches). Missing both headers fails closed."""
+    origin = request.headers.get("Origin")
+    if origin is None:
+        referer = request.headers.get("Referer")
+        if referer and "://" in referer:
+            scheme, _, rest = referer.partition("://")
+            origin = f"{scheme}://{rest.split('/', 1)[0]}"
+    if not origin or "://" not in origin:
+        return False
+    host = request.headers.get("X-Forwarded-Host", request.host)
+    host = host.split(",")[0].strip()
+    proto = request.headers.get(
+        "X-Forwarded-Proto", request.scheme or "http"
+    ).split(",")[0].strip()
+
+    def norm(scheme: str, authority: str) -> tuple[str, str, str]:
+        scheme = scheme.lower()
+        authority = authority.lower().rstrip(".")
+        default_port = {"http": "80", "https": "443"}.get(scheme, "")
+        if authority.startswith("["):  # bracketed IPv6: [::1] or [::1]:8080
+            h, _, rest = authority.partition("]")
+            h += "]"
+            p = rest[1:] if rest.startswith(":") else default_port
+        elif ":" in authority:
+            h, _, p = authority.rpartition(":")
+        else:
+            h, p = authority, default_port
+        return scheme, h.rstrip("."), p or default_port
+
+    o_scheme, _, o_rest = origin.partition("://")
+    return norm(o_scheme, o_rest.split("/", 1)[0]) == norm(proto, host)
+
+
+@web.middleware
+async def csrf_middleware(request: web.Request, handler):
+    """Double-submit CSRF for cookie-authenticated state changes (parity:
+    auth/middleware.rs:431-479 csrf_protect_middleware). Header-authenticated
+    requests (Authorization / x-api-key) are exempt — only the browser cookie
+    session is forgeable cross-site."""
+    if request.method not in ("POST", "PUT", "PATCH", "DELETE"):
+        return await handler(request)
+    if not request.path.startswith("/api/"):
+        return await handler(request)
+    if (request.method, request.path) in PUBLIC_PATHS:
+        return await handler(request)  # login/register establish the session
+    if "Authorization" in request.headers or "x-api-key" in request.headers:
+        return await handler(request)
+    if request.cookies.get(JWT_COOKIE) is None:
+        return await handler(request)  # not a cookie session; auth will 401
+
+    cookie_token = request.cookies.get(CSRF_COOKIE)
+    if not cookie_token:
+        return web.json_response({"error": "missing CSRF cookie"}, status=403)
+    header_token = request.headers.get("x-csrf-token")
+    if not header_token:
+        return web.json_response({"error": "missing CSRF header"}, status=403)
+    if cookie_token != header_token:
+        return web.json_response({"error": "invalid CSRF token"}, status=403)
+    if not _origin_matches(request):
+        return web.json_response(
+            {"error": "origin validation failed"}, status=403
+        )
+    return await handler(request)
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     state: AppState = request.app["state"]
@@ -135,6 +208,11 @@ async def auth_middleware(request: web.Request, handler):
     authz = request.headers.get("Authorization", "")
     if authz.startswith("Bearer "):
         bearer = authz[7:].strip()
+    # Dashboard cookie session — accepted only on the /api/* surface, where
+    # csrf_middleware guards state changes. /v1/* stays header-auth-only so a
+    # cross-site form POST can never ride the browser cookie into inference.
+    if not bearer and path.startswith("/api/"):
+        bearer = request.cookies.get(JWT_COOKIE)
     anthropic_key = request.headers.get("x-api-key")  # Anthropic-style
 
     auth_ctx: dict | None = None
@@ -218,7 +296,9 @@ async def auth_middleware(request: web.Request, handler):
 def create_app(state: AppState) -> web.Application:
     app = web.Application(
         client_max_size=MAX_BODY_BYTES,
-        middlewares=[audit_middleware, gate_middleware, auth_middleware],
+        middlewares=[
+            audit_middleware, gate_middleware, csrf_middleware, auth_middleware,
+        ],
     )
     app["state"] = state
     r = app.router
@@ -241,6 +321,7 @@ def create_app(state: AppState) -> web.Application:
 
     # ---- auth
     r.add_post("/api/auth/login", api_admin.login)
+    r.add_post("/api/auth/logout", api_admin.logout)
     r.add_post("/api/auth/register", api_admin.register_with_invitation)
     r.add_get("/api/auth/me", api_admin.me)
     r.add_post("/api/auth/change-password", api_admin.change_password)
@@ -326,6 +407,7 @@ def create_app(state: AppState) -> web.Application:
     )
     r.add_get("/api/dashboard/model-tps", api_dashboard.model_tps)
     r.add_get("/api/dashboard/clients", api_dashboard.client_analytics)
+    r.add_get("/api/dashboard/logs/lb", api_dashboard.tail_lb_logs)
     r.add_get("/ws/dashboard", api_dashboard.dashboard_ws)
 
     # ---- benchmarks + cloud metrics

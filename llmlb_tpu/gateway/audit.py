@@ -25,6 +25,13 @@ FLUSH_INTERVAL_S = 1.0
 FLUSH_MAX_ENTRIES = 64
 
 
+def fts_quote(q: str) -> str:
+    """Quote each whitespace-separated term so user input is matched as plain
+    terms (AND semantics), never parsed as FTS5 syntax (NEAR, *, ^, etc.)."""
+    terms = [t.replace('"', '""') for t in q.split()]
+    return " ".join(f'"{t}"' for t in terms if t)
+
+
 @dataclasses.dataclass
 class AuditEntry:
     ts: float
@@ -149,11 +156,21 @@ class AuditLog:
         limit: int = 100,
         offset: int = 0,
     ) -> list[dict]:
+        """Free-text `q` uses the FTS5 index over (path, actor, detail)
+        (parity: db/audit_log.rs:82-98); LIKE fallback when sqlite lacks
+        fts5. User text is quoted per-term so FTS operators can't inject."""
         clauses, params = [], []
-        if q:
-            clauses.append("(path LIKE ? OR detail LIKE ? OR actor LIKE ?)")
-            like = f"%{q}%"
-            params += [like, like, like]
+        if q and q.strip():
+            if getattr(self.db, "fts_enabled", False):
+                clauses.append(
+                    "id IN (SELECT rowid FROM audit_log_fts "
+                    "WHERE audit_log_fts MATCH ?)"
+                )
+                params.append(fts_quote(q))
+            else:
+                clauses.append("(path LIKE ? OR detail LIKE ? OR actor LIKE ?)")
+                like = f"%{q}%"
+                params += [like, like, like]
         if actor:
             clauses.append("actor=?")
             params.append(actor)

@@ -37,6 +37,11 @@ class AuthError(Exception):
 # ---------------------------------------------------------------------- JWT
 
 
+# Dashboard cookie names (parity: reference auth/mod.rs DASHBOARD_*_COOKIE).
+JWT_COOKIE = "llmlb_jwt"
+CSRF_COOKIE = "llmlb_csrf"
+
+
 def _b64url(data: bytes) -> str:
     return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
 

@@ -195,6 +195,27 @@ CREATE TABLE IF NOT EXISTS download_tasks (
 """
 
 
+# Full-text audit search (parity: db/audit_log.rs:82-98 FTS5 table+triggers).
+# Kept out of SCHEMA so a sqlite build without the fts5 module still boots
+# (AuditLog.search falls back to LIKE when Database.fts_enabled is False).
+# External-content table: rows live in audit_log; triggers keep FTS in sync,
+# including deletes from the 90-day archiver.
+FTS_SCHEMA = """
+CREATE VIRTUAL TABLE IF NOT EXISTS audit_log_fts USING fts5(
+    path, actor, detail,
+    content='audit_log', content_rowid='id'
+);
+CREATE TRIGGER IF NOT EXISTS audit_log_fts_ai AFTER INSERT ON audit_log BEGIN
+    INSERT INTO audit_log_fts(rowid, path, actor, detail)
+    VALUES (new.id, new.path, new.actor, new.detail);
+END;
+CREATE TRIGGER IF NOT EXISTS audit_log_fts_ad AFTER DELETE ON audit_log BEGIN
+    INSERT INTO audit_log_fts(audit_log_fts, rowid, path, actor, detail)
+    VALUES ('delete', old.id, old.path, old.actor, old.detail);
+END;
+"""
+
+
 def _caps_to_json(caps: Iterable[Capability]) -> str:
     return json.dumps([c.value for c in caps])
 
@@ -224,6 +245,28 @@ class Database:
         self._lock = threading.RLock()
         with self._lock:
             self._conn.executescript(SCHEMA)
+            try:
+                # Backfill on upgrade: a DB that predates the FTS table has
+                # unindexed rows — searches would miss them and the delete
+                # trigger would corrupt the external-content index when the
+                # archiver removes a never-indexed rowid. (count(*) can't
+                # detect this: on external-content tables it reads the
+                # content table, so test table existence instead.)
+                fts_is_new = not self._conn.execute(
+                    "SELECT 1 FROM sqlite_master WHERE name='audit_log_fts'"
+                ).fetchone()
+                self._conn.executescript(FTS_SCHEMA)
+                self.fts_enabled = True
+                has_rows = self._conn.execute(
+                    "SELECT 1 FROM audit_log LIMIT 1"
+                ).fetchone()
+                if fts_is_new and has_rows:
+                    self._conn.execute(
+                        "INSERT INTO audit_log_fts(audit_log_fts) "
+                        "VALUES('rebuild')"
+                    )
+            except sqlite3.OperationalError:
+                self.fts_enabled = False
 
     def close(self) -> None:
         with self._lock:

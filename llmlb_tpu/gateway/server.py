@@ -74,10 +74,10 @@ def main(argv: list[str] | None = None) -> None:
     stop.add_argument("--port", type=int, default=None)
 
     args = parser.parse_args(argv)
-    logging.basicConfig(
-        level=os.environ.get("LLMLB_LOG_LEVEL", "INFO").upper(),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    from llmlb_tpu.gateway.logging_setup import init_logging
+
+    # stderr + daily-rotated file sink (reference logging.rs:41-182)
+    init_logging()
 
     config = ServerConfig.from_env()
     if getattr(args, "host", None):

@@ -72,3 +72,87 @@ def test_archive_reanchors_chain(tmp_path):
     import sqlite3
     arch = sqlite3.connect(archive_path)
     assert arch.execute("SELECT COUNT(*) FROM audit_log").fetchone()[0] == 1
+
+
+def test_fts_search_and_injection_safety():
+    db = Database(":memory:")
+    assert db.fts_enabled
+    log = AuditLog(db)
+    log.record(AuditEntry(ts=time.time(), method="POST", path="/api/users",
+                          status=201, duration_ms=1, actor="alice",
+                          detail="created user bob"))
+    log.record(AuditEntry(ts=time.time(), method="POST", path="/api/endpoints",
+                          status=201, duration_ms=1, actor="carol",
+                          detail="registered tpu endpoint"))
+    log.flush()
+    # token match across columns
+    assert len(log.search(q="bob")) == 1
+    assert len(log.search(q="alice")) == 1
+    # multi-term AND semantics
+    assert len(log.search(q="created bob")) == 1
+    assert len(log.search(q="created carol")) == 0
+    # FTS operators must be inert user text, not syntax errors
+    for hostile in ('NEAR(', 'a AND b OR', '"unbalanced', 'path:*', '^x'):
+        log.search(q=hostile)  # must not raise
+    # whitespace-only query is a no-filter search
+    assert len(log.search(q="   ")) == 2
+
+
+def test_fts_stays_in_sync_with_deletes(tmp_path):
+    db = Database(":memory:")
+    log = AuditLog(db)
+    log.record(AuditEntry(ts=time.time() - 100 * 86400, method="GET",
+                          path="/ancient", status=200, duration_ms=1))
+    log.flush()
+    log.record(AuditEntry(ts=time.time(), method="GET", path="/fresh",
+                          status=200, duration_ms=1))
+    log.flush()
+    log.archive_older_than(time.time() - 90 * 86400,
+                           str(tmp_path / "archive.db"))
+    # the delete trigger removed the archived row from the index
+    assert len(log.search(q="ancient")) == 0
+    assert len(log.search(q="fresh")) == 1
+
+
+def test_search_like_fallback_when_fts_unavailable():
+    db = Database(":memory:")
+    db.fts_enabled = False  # simulate a sqlite build without fts5
+    log = AuditLog(db)
+    log.record(AuditEntry(ts=time.time(), method="POST", path="/api/users",
+                          status=201, duration_ms=1, detail="made bob"))
+    log.flush()
+    assert len(log.search(q="bob")) == 1
+    assert len(log.search(q="nope")) == 0
+
+
+def test_fts_backfill_on_upgrade(tmp_path):
+    """A DB created before the FTS table must be backfilled at open, or
+    archive deletes corrupt the external-content index."""
+    import sqlite3
+
+    path = str(tmp_path / "old.db")
+    conn = sqlite3.connect(path)
+    conn.executescript("""
+        CREATE TABLE audit_log (
+            id INTEGER PRIMARY KEY AUTOINCREMENT, ts REAL NOT NULL,
+            method TEXT NOT NULL, path TEXT NOT NULL, status INTEGER NOT NULL,
+            duration_ms REAL NOT NULL, actor TEXT, actor_type TEXT, ip TEXT,
+            detail TEXT, batch_id INTEGER);
+    """)
+    conn.execute(
+        "INSERT INTO audit_log (ts,method,path,status,duration_ms,detail) "
+        "VALUES (?,?,?,?,?,?)",
+        (time.time() - 100 * 86400, "GET", "/prehistoric", 200, 1.0, "old row"),
+    )
+    conn.commit()
+    conn.close()
+
+    db = Database(path)
+    log = AuditLog(db)
+    # pre-existing row is searchable (backfill ran)
+    assert len(log.search(q="prehistoric")) == 1
+    # and archiving it does not corrupt the index
+    log.archive_older_than(time.time() - 90 * 86400,
+                           str(tmp_path / "arch.db"))
+    assert len(log.search(q="prehistoric")) == 0
+    db.execute("INSERT INTO audit_log_fts(audit_log_fts) VALUES('integrity-check')")

@@ -1,0 +1,201 @@
+"""Cookie sessions, CSRF double-submit protection, and the log-tail API.
+
+Parity targets: auth/middleware.rs:431-479 (csrf_protect_middleware),
+logging.rs:41-182 (rotating file sink), api/logs.rs:52 (log tail).
+"""
+
+import asyncio
+
+from llmlb_tpu.gateway.auth import CSRF_COOKIE, JWT_COOKIE
+from tests.support import ADMIN_PASSWORD, GatewayHarness
+
+
+def _session_cookies(resp) -> dict:
+    jar = {}
+    for c in resp.headers.getall("Set-Cookie", []):
+        first = c.split(";", 1)[0]
+        k, _, v = first.partition("=")
+        jar[k] = v
+    return jar
+
+
+async def _login_cookies(gw) -> dict:
+    resp = await gw.client.post("/api/auth/login", json={
+        "username": "admin", "password": ADMIN_PASSWORD,
+    })
+    assert resp.status == 200
+    jar = _session_cookies(resp)
+    assert JWT_COOKIE in jar and CSRF_COOKIE in jar
+    return jar
+
+
+def _cookie_header(jar: dict) -> str:
+    return "; ".join(f"{k}={v}" for k, v in jar.items())
+
+
+def test_cookie_session_get_works_without_csrf():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            jar = await _login_cookies(gw)
+            resp = await gw.client.get(
+                "/api/endpoints", headers={"Cookie": _cookie_header(jar)}
+            )
+            assert resp.status == 200
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_cookie_post_requires_csrf_token():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            jar = await _login_cookies(gw)
+            base = {"Cookie": _cookie_header(jar)}
+            body = {"base_url": "http://127.0.0.1:9", "name": "x",
+                    "endpoint_type": "openai_compatible"}
+
+            # no CSRF header → 403
+            resp = await gw.client.post("/api/endpoints", json=body,
+                                        headers=base)
+            assert resp.status == 403
+
+            # wrong token → 403
+            resp = await gw.client.post(
+                "/api/endpoints", json=body,
+                headers={**base, "x-csrf-token": "wrong"},
+            )
+            assert resp.status == 403
+
+            # right token but cross-site origin → 403
+            resp = await gw.client.post(
+                "/api/endpoints", json=body,
+                headers={**base, "x-csrf-token": jar[CSRF_COOKIE],
+                         "Origin": "http://evil.example"},
+            )
+            assert resp.status == 403
+
+            # right token + same origin → accepted
+            host = f"http://{gw.client.host}:{gw.client.port}"
+            resp = await gw.client.post(
+                "/api/endpoints", json=body,
+                headers={**base, "x-csrf-token": jar[CSRF_COOKIE],
+                         "Origin": host},
+            )
+            assert resp.status == 201, await resp.text()
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_bearer_auth_bypasses_csrf():
+    """Header-authenticated requests are not CSRF targets."""
+
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            resp = await gw.client.post(
+                "/api/invitations", json={"role": "viewer"},
+                headers=await gw.admin_headers(),
+            )
+            assert resp.status in (200, 201), await resp.text()
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_cookie_csrf_missing_cookie_but_header_present():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            jar = await _login_cookies(gw)
+            only_jwt = {JWT_COOKIE: jar[JWT_COOKIE]}
+            resp = await gw.client.post(
+                "/api/invitations", json={"role": "viewer"},
+                headers={"Cookie": _cookie_header(only_jwt),
+                         "x-csrf-token": jar[CSRF_COOKIE]},
+            )
+            assert resp.status == 403
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_logout_clears_cookies():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            jar = await _login_cookies(gw)
+            host = f"http://{gw.client.host}:{gw.client.port}"
+            resp = await gw.client.post(
+                "/api/auth/logout",
+                headers={"Cookie": _cookie_header(jar),
+                         "x-csrf-token": jar[CSRF_COOKIE], "Origin": host},
+            )
+            assert resp.status == 200
+            cleared = _session_cookies(resp)
+            assert cleared.get(JWT_COOKIE, "x") in ("", '""')
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_rotating_log_sink_and_tail(tmp_path):
+    from llmlb_tpu.gateway import logging_setup
+
+    path = logging_setup.init_logging(str(tmp_path), file_sink=True)
+    assert path is not None
+    import logging as pylog
+
+    for i in range(50):
+        pylog.getLogger("llmlb_tpu.test").info("line %d", i)
+    for h in pylog.getLogger().handlers:
+        h.flush()
+    lines = logging_setup.tail_log(10)
+    assert len(lines) == 10
+    assert "line 49" in lines[-1]
+    # bounded even for absurd requests
+    assert len(logging_setup.tail_log(10**9)) <= 5000
+    logging_setup.init_logging(str(tmp_path), file_sink=False)
+
+
+def test_log_tail_api():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            resp = await gw.client.get(
+                "/api/dashboard/logs/lb", headers=await gw.admin_headers()
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert "lines" in body and "available" in body
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_cookie_jwt_rejected_on_v1_surface():
+    """The dashboard cookie must never authenticate inference — a cross-site
+    form POST rides cookies, and /v1/* has no CSRF middleware."""
+
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            jar = await _login_cookies(gw)
+            resp = await gw.client.post(
+                "/v1/chat/completions",
+                json={"model": "m", "messages": []},
+                headers={"Cookie": _cookie_header(jar)},
+            )
+            assert resp.status == 401
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
