@@ -471,7 +471,7 @@ async def _update_check(request: web.Request) -> web.Response:
     state: AppState = request.app["state"]
     if state.update_manager is None:
         return web.json_response({"error": "updates not configured"}, status=501)
-    return web.json_response(await state.update_manager.check())
+    return web.json_response(await state.update_manager.check(force=True))
 
 
 async def _update_apply(request: web.Request) -> web.Response:

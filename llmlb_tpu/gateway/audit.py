@@ -27,9 +27,11 @@ FLUSH_MAX_ENTRIES = 64
 
 def fts_quote(q: str) -> str:
     """Quote each whitespace-separated term so user input is matched as plain
-    terms (AND semantics), never parsed as FTS5 syntax (NEAR, *, ^, etc.)."""
+    terms (AND semantics), never parsed as FTS5 syntax (NEAR, *, ^, etc.).
+    Each term is a prefix query ("tok"*) so partial identifiers keep working
+    the way the LIKE fallback's substring match mostly did."""
     terms = [t.replace('"', '""') for t in q.split()]
-    return " ".join(f'"{t}"' for t in terms if t)
+    return " ".join(f'"{t}"*' for t in terms if t)
 
 
 @dataclasses.dataclass

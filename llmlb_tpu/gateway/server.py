@@ -35,18 +35,49 @@ async def run_server(config: ServerConfig | None = None) -> None:
 
     lock = ServerLock.acquire(config.port)
     state = await build_app_state(config)
-    state.update_manager = UpdateManager(
-        state.gate, state.events, drain_timeout_s=config.update_drain_timeout_s
+    stop_event = asyncio.Event()
+
+    from llmlb_tpu import __version__
+
+    # Real self-update wiring when LLMLB_UPDATE_REPO/ARTIFACT are set:
+    # restart = graceful exit, the supervisor re-execs the (new) artifact.
+    state.update_manager = UpdateManager.from_env(
+        state.gate, state.http, __version__, events=state.events,
+        drain_timeout_s=config.update_drain_timeout_s,
+        restart_cb=stop_event.set,
     )
+    state.update_manager.start_background_tasks()
     app = create_app(state)
 
-    runner = web.AppRunner(app)
+    # Short shutdown grace: idle keep-alive connections must not delay a
+    # supervisor restart (observed: default 60 s stalls the update re-exec).
+    runner = web.AppRunner(app, shutdown_timeout=5.0)
     await runner.setup()
     site = web.TCPSite(runner, config.host, config.port)
     await site.start()
     log.info("llmlb_tpu gateway listening on %s:%d", config.host, config.port)
 
-    stop_event = asyncio.Event()
+    probe_host = config.host
+    if probe_host in ("0.0.0.0", "::", ""):
+        probe_host = "127.0.0.1"
+    elif ":" in probe_host:  # bare IPv6 address needs brackets in a URL
+        probe_host = f"[{probe_host}]"
+
+    async def self_health() -> bool:
+        try:
+            async with state.http.get(
+                f"http://{probe_host}:{config.port}/health", timeout=2
+            ) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    # If we just restarted into a freshly applied update, watch health for
+    # 30 s and roll back from .bak on failure (reference post-restart watch).
+    watch_task = asyncio.create_task(
+        state.update_manager.post_restart_watch(self_health)
+    )
+
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
@@ -57,6 +88,8 @@ async def run_server(config: ServerConfig | None = None) -> None:
         await stop_event.wait()
     finally:
         log.info("shutting down")
+        watch_task.cancel()
+        await state.update_manager.stop_background_tasks()
         await runner.cleanup()
         lock.release()
 

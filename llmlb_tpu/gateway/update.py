@@ -1,11 +1,15 @@
-"""Self-update manager: state machine + drain-aware apply.
+"""Self-update manager: state machine + drain-aware apply + rollback watch.
 
 Parity with reference update/ (state machine mod.rs:59-123, background tasks
-:807-905, drain via InferenceGate, scheduling schedule.rs:17-43, post-apply
-health watch + rollback). The binary-swap mechanics differ (we restart the
-Python process via an operator-provided hook or exit-for-supervisor), but the
-externally observable lifecycle — check → available → draining (503s on /v1/*)
-→ applying → restart — and the admin API shape are preserved.
+:807-905, check/download :965+, drain via InferenceGate, scheduling
+schedule.rs:17-90, post-restart health watch + rollback README.md:160-166).
+The swap unit is an operator-configured artifact (update_source.py) rather
+than a Rust binary, but the externally observable lifecycle — hourly check →
+available → download w/ progress → draining (503s on /v1/*) → applying →
+restart → 30 s health watch with `.bak` rollback — and the admin API shape
+are preserved. Hooks remain injectable for tests; the defaults are the real
+GitHub + artifact-swap implementations when configured via
+LLMLB_UPDATE_REPO / LLMLB_UPDATE_ARTIFACT.
 """
 
 from __future__ import annotations
@@ -14,12 +18,18 @@ import asyncio
 import dataclasses
 import enum
 import logging
+import os
+import tempfile
 import time
 
 from llmlb_tpu.gateway.events import DashboardEventBus
 from llmlb_tpu.gateway.gate import InferenceGate
 
 log = logging.getLogger("llmlb_tpu.gateway.update")
+
+CHECK_INTERVAL_S = 3600.0  # parity: hourly background check
+POST_RESTART_WATCH_S = 30.0  # parity: 30 s health watch after restart
+SCHEDULE_TICK_S = 5.0
 
 
 class UpdateState(str, enum.Enum):
@@ -49,19 +59,57 @@ class UpdateManager:
         drain_timeout_s: float = 300.0,
         apply_hook=None,  # async callable that performs the actual swap/restart
         check_hook=None,  # async callable returning {"version": ..} | None
+        source=None,  # GitHubUpdateSource (or compatible)
+        applier=None,  # ArtifactSwapApplier (or compatible)
+        restart_cb=None,  # sync/async: hand control to the supervisor
     ):
         self.gate = gate
         self.events = events
         self.drain_timeout_s = drain_timeout_s
         self.apply_hook = apply_hook
         self.check_hook = check_hook
+        self.source = source
+        self.applier = applier
+        self.restart_cb = restart_cb
         self.state = UpdateState.UP_TO_DATE
         self.available_version: str | None = None
+        self.available_asset_url: str | None = None
+        self.downloaded_path: str | None = None
+        self._downloaded_version: str | None = None
+        self.download_progress: dict | None = None  # {"done": n, "total": n}
         self.error: str | None = None
         self.schedule = ScheduleConfig()
         self.history: list[dict] = []
         self.last_check_at: float | None = None
         self._apply_task: asyncio.Task | None = None
+        self._bg_tasks: list[asyncio.Task] = []
+
+    @classmethod
+    def from_env(cls, gate: InferenceGate, http, current_version: str,
+                 events: DashboardEventBus | None = None,
+                 drain_timeout_s: float = 300.0,
+                 restart_cb=None) -> "UpdateManager":
+        """Build with the real GitHub + artifact-swap hooks when
+        LLMLB_UPDATE_REPO / LLMLB_UPDATE_ARTIFACT are configured."""
+        from llmlb_tpu.gateway.update_source import (
+            ArtifactSwapApplier,
+            GitHubUpdateSource,
+        )
+
+        repo = os.environ.get("LLMLB_UPDATE_REPO")
+        artifact = os.environ.get("LLMLB_UPDATE_ARTIFACT")
+        source = GitHubUpdateSource(
+            http, repo, current_version,
+            asset_match=os.environ.get("LLMLB_UPDATE_ASSET_MATCH", ""),
+            api_base=os.environ.get(
+                "LLMLB_UPDATE_API_BASE", "https://api.github.com"
+            ),
+        ) if repo else None
+        applier = ArtifactSwapApplier(artifact) if artifact else None
+        return cls(
+            gate, events, drain_timeout_s=drain_timeout_s,
+            source=source, applier=applier, restart_cb=restart_cb,
+        )
 
     def _set_state(self, state: UpdateState) -> None:
         self.state = state
@@ -75,29 +123,74 @@ class UpdateManager:
         return {
             "state": self.state.value,
             "available_version": self.available_version,
+            "download_progress": self.download_progress,
             "error": self.error,
             "last_check_at": self.last_check_at,
             "schedule": dataclasses.asdict(self.schedule),
             "history": self.history[-10:],
         }
 
-    async def check(self) -> dict:
-        """Query for an available update (hourly in reference; on-demand here —
-        this environment has no egress, so the default check_hook is None)."""
+    async def check(self, force: bool = False) -> dict:
+        """Query for an available update (hourly background + on demand).
+        Priority: injected check_hook (tests) > GitHub source > none."""
         self.last_check_at = time.time()
-        if self.check_hook is None:
-            return {"available": False}
         try:
-            info = await self.check_hook()
+            if self.check_hook is not None:
+                info = await self.check_hook()
+            elif self.source is not None:
+                info = await self.source.check(force=force)
+            else:
+                return {"available": False}
         except Exception as e:
             self.error = str(e)
             return {"available": False, "error": str(e)}
+        applying = self._apply_task is not None and not self._apply_task.done()
         if info and info.get("version"):
             self.available_version = info["version"]
-            self._set_state(UpdateState.AVAILABLE)
-            return {"available": True, "version": info["version"]}
-        self._set_state(UpdateState.UP_TO_DATE)
+            self.available_asset_url = info.get("asset_url")
+            if not applying:  # never stomp DRAINING/APPLYING mid-apply
+                self._set_state(UpdateState.AVAILABLE)
+            return {"available": True, **info}
+        if not applying:
+            self._set_state(UpdateState.UP_TO_DATE)
         return {"available": False}
+
+    async def download(self) -> str | None:
+        """Fetch the available asset to a staging path, publishing progress
+        events (update/mod.rs download-with-progress)."""
+        if self.source is None or not self.available_asset_url:
+            return None
+        # Cache is keyed by version: a staged download from a previous
+        # release must never be applied under a newer version's label.
+        if (self.downloaded_path
+                and self._downloaded_version == self.available_version
+                and os.path.isfile(self.downloaded_path)):
+            return self.downloaded_path
+        # Stage next to the artifact when possible (same filesystem, private
+        # service dir); else a fresh 0700 tempdir — never a predictable path
+        # in world-writable /tmp.
+        if self.applier is not None:
+            staging_dir = self.applier.state_dir
+        else:
+            staging_dir = tempfile.mkdtemp(prefix="llmlb-update-")
+        staging = os.path.join(
+            staging_dir, f"llmlb-update-{self.available_version}"
+        )
+
+        def progress(done: int, total: int) -> None:
+            self.download_progress = {"done": done, "total": total}
+            if self.events and (total == 0 or done == total or
+                                done % (1 << 22) < (1 << 16)):
+                self.events.publish("UpdateDownloadProgress", {
+                    "version": self.available_version,
+                    "done": done, "total": total,
+                })
+
+        self.downloaded_path = await self.source.download(
+            self.available_asset_url, staging, progress_cb=progress
+        )
+        self._downloaded_version = self.available_version
+        return self.downloaded_path
 
     def request_apply(self, mode: ApplyMode = ApplyMode.NORMAL) -> bool:
         if self._apply_task and not self._apply_task.done():
@@ -108,6 +201,21 @@ class UpdateManager:
     async def _apply_flow(self, mode: ApplyMode) -> None:
         """drain → apply → (restart handled by hook). Reference §3.4 call stack."""
         started = time.time()
+        # Fetch the asset BEFORE rejecting traffic: a slow multi-hundred-MB
+        # download must not extend the 503 window beyond the swap itself.
+        staged = None
+        if self.apply_hook is None and self.applier is not None:
+            try:
+                staged = await self.download()
+            except Exception as e:
+                self.error = str(e)
+                self.history.append({
+                    "version": self.available_version, "mode": mode.value,
+                    "started_at": started, "finished_at": time.time(),
+                    "ok": False, "error": str(e),
+                })
+                self._set_state(UpdateState.FAILED)
+                return
         self._set_state(UpdateState.DRAINING)
         self.gate.start_rejecting()  # /v1/* now 503 + Retry-After
         try:
@@ -121,6 +229,17 @@ class UpdateManager:
             self._set_state(UpdateState.APPLYING)
             if self.apply_hook is not None:
                 await self.apply_hook()
+            elif self.applier is not None:
+                if staged is None:
+                    raise RuntimeError(
+                        "no downloadable asset for "
+                        f"{self.available_version or 'update'}"
+                    )
+                self.applier.apply(staged, self.available_version)
+                if self.restart_cb is not None:
+                    r = self.restart_cb()
+                    if asyncio.iscoroutine(r):
+                        await r
             self.history.append({
                 "version": self.available_version,
                 "mode": mode.value,
@@ -156,3 +275,114 @@ class UpdateManager:
         if mode not in ("immediate", "on_idle", "at_time"):
             raise ValueError(f"unknown schedule mode {mode!r}")
         self.schedule = ScheduleConfig(mode=mode, at_time=at_time)
+
+    # ------------------------------------------------------- background tasks
+
+    def start_background_tasks(
+        self, check_interval_s: float = CHECK_INTERVAL_S
+    ) -> None:
+        """Hourly release check + schedule executor (update/mod.rs:807-905,
+        schedule.rs:17-90)."""
+        self._bg_tasks.append(asyncio.create_task(
+            self._check_loop(check_interval_s), name="update-check"
+        ))
+        self._bg_tasks.append(asyncio.create_task(
+            self._schedule_loop(), name="update-schedule"
+        ))
+
+    async def stop_background_tasks(self) -> None:
+        for t in self._bg_tasks:
+            t.cancel()
+        for t in self._bg_tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._bg_tasks.clear()
+
+    async def _check_loop(self, interval_s: float) -> None:
+        while True:
+            try:
+                await self.check()
+            except Exception:
+                log.exception("background update check failed")
+            await asyncio.sleep(interval_s)
+
+    async def _schedule_loop(self) -> None:
+        """Fire a pending AVAILABLE update per the configured schedule:
+        on_idle waits for zero in-flight inference; at_time waits for the
+        wall clock. 'immediate' keeps apply operator-triggered (API parity:
+        the reference's Immediate mode is what /update/apply does)."""
+        while True:
+            await asyncio.sleep(SCHEDULE_TICK_S)
+            try:
+                if self.state != UpdateState.AVAILABLE:
+                    continue
+                mode = self.schedule.mode
+                if mode == "on_idle" and self.gate.in_flight == 0:
+                    log.info("on_idle schedule firing update apply")
+                    self.request_apply(ApplyMode.NORMAL)
+                elif (mode == "at_time" and self.schedule.at_time
+                        and time.time() >= self.schedule.at_time):
+                    log.info("at_time schedule firing update apply")
+                    self.schedule = ScheduleConfig()  # one-shot
+                    self.request_apply(ApplyMode.NORMAL)
+            except Exception:
+                log.exception("schedule loop failure")
+
+    # ---------------------------------------------------- post-restart watch
+
+    async def post_restart_watch(
+        self, health_check, watch_s: float = POST_RESTART_WATCH_S,
+        interval_s: float = 1.0,
+    ) -> str:
+        """After a restart with a pending-update marker: confirm the new
+        version is healthy for `watch_s`, else roll back from `.bak`
+        (reference 30 s health watch + auto-rollback). `health_check` is an
+        async callable returning truthy when serving is healthy.
+
+        Returns one of: "no_marker", "healthy", "rolled_back",
+        "rollback_failed"."""
+        if self.applier is None:
+            return "no_marker"
+        marker = self.applier.read_marker()
+        if not marker:
+            return "no_marker"
+        deadline = time.monotonic() + watch_s
+        healthy_streak = 0
+        while time.monotonic() < deadline:
+            try:
+                ok = await health_check()
+            except Exception:
+                ok = False
+            if ok:
+                healthy_streak += 1
+                if healthy_streak >= 3:  # stable, not a lucky first probe
+                    self.applier.clear_marker()
+                    self.history.append({
+                        "version": marker.get("version"),
+                        "post_restart": "healthy", "ts": time.time(),
+                    })
+                    log.info("update %s confirmed healthy",
+                             marker.get("version"))
+                    return "healthy"
+            else:
+                healthy_streak = 0
+            await asyncio.sleep(interval_s)
+        rolled = self.applier.rollback()
+        self.history.append({
+            "version": marker.get("version"),
+            "post_restart": "rolled_back" if rolled else "rollback_failed",
+            "ts": time.time(),
+        })
+        self._set_state(UpdateState.FAILED)
+        self.error = (
+            f"update {marker.get('version')} unhealthy after restart; "
+            + ("rolled back" if rolled else "rollback failed (no .bak)")
+        )
+        log.error("%s", self.error)
+        if rolled and self.restart_cb is not None:
+            r = self.restart_cb()
+            if asyncio.iscoroutine(r):
+                await r
+        return "rolled_back" if rolled else "rollback_failed"

@@ -1,0 +1,241 @@
+"""Self-update lifecycle: GitHub check → download w/ progress → drain →
+artifact swap with .bak → post-restart health watch → rollback on unhealthy.
+
+Parity targets: update/mod.rs:59-123 (state machine), :807-965 (background
+check + download), schedule.rs:17-90, README.md:160-166 (rollback).
+"""
+
+import asyncio
+import json
+import os
+import time
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.gate import InferenceGate
+from llmlb_tpu.gateway.update import ApplyMode, UpdateManager, UpdateState
+from llmlb_tpu.gateway.update_source import (
+    ArtifactSwapApplier,
+    GitHubUpdateSource,
+    is_newer,
+)
+
+
+class MockGitHub:
+    """Minimal GitHub Releases API: latest release + one downloadable asset."""
+
+    def __init__(self, version="v9.9.9", asset=b"NEW ARTIFACT BYTES " * 64):
+        self.version = version
+        self.asset = asset
+        self.check_count = 0
+        self.server: TestServer | None = None
+
+    @property
+    def api_base(self) -> str:
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get(
+            "/repos/acme/llmlb-tpu/releases/latest", self._latest
+        )
+        app.router.add_get("/assets/app.bin", self._asset)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def stop(self):
+        await self.server.close()
+
+    async def _latest(self, request):
+        self.check_count += 1
+        return web.json_response({
+            "tag_name": self.version,
+            "body": "release notes",
+            "assets": [{
+                "name": "app.bin",
+                "browser_download_url": f"{self.api_base}/assets/app.bin",
+            }],
+        })
+
+    async def _asset(self, request):
+        return web.Response(
+            body=self.asset,
+            headers={"Content-Length": str(len(self.asset))},
+        )
+
+
+def test_version_comparison():
+    assert is_newer("v2.0.0", "1.9.9")
+    assert is_newer("1.10.0", "1.9.0")
+    assert not is_newer("1.0.0", "1.0.0")
+    assert not is_newer("v0.9.0", "1.0.0")
+
+
+def test_full_update_lifecycle(tmp_path):
+    async def run():
+        import aiohttp
+
+        gh = await MockGitHub().start()
+        artifact = tmp_path / "app.bin"
+        artifact.write_bytes(b"OLD ARTIFACT")
+        restarts = []
+
+        async with aiohttp.ClientSession() as http:
+            gate = InferenceGate()
+            events = DashboardEventBus()
+            mgr = UpdateManager(
+                gate, events, drain_timeout_s=2.0,
+                source=GitHubUpdateSource(
+                    http, "acme/llmlb-tpu", "1.0.0",
+                    api_base=gh.api_base,
+                ),
+                applier=ArtifactSwapApplier(str(artifact)),
+                restart_cb=lambda: restarts.append(time.time()),
+            )
+
+            # ---- check: finds the newer release
+            res = await mgr.check()
+            assert res["available"] and res["version"] == "v9.9.9"
+            assert mgr.state == UpdateState.AVAILABLE
+
+            # 24h cache: a second check does not re-hit the API
+            n = gh.check_count
+            await mgr.check()
+            assert gh.check_count == n
+
+            # ---- drain semantics: one slow in-flight inference delays apply
+            async def fake_inference():
+                with gate.track():
+                    await asyncio.sleep(0.3)
+
+            inflight = asyncio.create_task(fake_inference())
+            await asyncio.sleep(0.05)
+            assert mgr.request_apply(ApplyMode.NORMAL)
+            assert not mgr.request_apply(ApplyMode.NORMAL)  # one at a time
+            await asyncio.sleep(0.05)
+            assert mgr.state == UpdateState.DRAINING
+            assert gate.rejecting  # /v1/* would 503 now
+            await inflight
+            await mgr._apply_task
+
+            # ---- artifact swapped, .bak kept, marker written, restart fired
+            assert artifact.read_bytes() == gh.asset
+            assert (tmp_path / "app.bin.bak").read_bytes() == b"OLD ARTIFACT"
+            marker = json.loads((tmp_path / "update_pending.json").read_text())
+            assert marker["version"] == "v9.9.9"
+            assert len(restarts) == 1
+            assert mgr.download_progress["done"] == len(gh.asset)
+            assert mgr.history[-1]["ok"] is True
+
+            # ---- post-restart watch: healthy clears the marker
+            async def healthy():
+                return True
+
+            out = await mgr.post_restart_watch(
+                healthy, watch_s=2.0, interval_s=0.01
+            )
+            assert out == "healthy"
+            assert not os.path.exists(tmp_path / "update_pending.json")
+
+        await gh.stop()
+
+    asyncio.run(run())
+
+
+def test_post_restart_rollback_on_unhealthy(tmp_path):
+    async def run():
+        artifact = tmp_path / "app.bin"
+        artifact.write_bytes(b"BROKEN NEW VERSION")
+        (tmp_path / "app.bin.bak").write_bytes(b"GOOD OLD VERSION")
+        applier = ArtifactSwapApplier(str(artifact))
+        applier.write_marker("v9.9.9")
+        restarts = []
+        mgr = UpdateManager(
+            InferenceGate(), applier=applier,
+            restart_cb=lambda: restarts.append(1),
+        )
+
+        async def never_healthy():
+            return False
+
+        out = await mgr.post_restart_watch(
+            never_healthy, watch_s=0.2, interval_s=0.02
+        )
+        assert out == "rolled_back"
+        assert artifact.read_bytes() == b"GOOD OLD VERSION"
+        assert not os.path.exists(tmp_path / "update_pending.json")
+        assert mgr.state == UpdateState.FAILED
+        assert restarts == [1]  # re-exec back into the old version
+
+    asyncio.run(run())
+
+
+def test_schedule_on_idle_and_at_time(tmp_path):
+    async def run():
+        gate = InferenceGate()
+        applied = []
+
+        async def apply_hook():
+            applied.append(time.time())
+
+        mgr = UpdateManager(gate, apply_hook=apply_hook, drain_timeout_s=0.5)
+        mgr.available_version = "v2.0.0"
+        mgr._set_state(UpdateState.AVAILABLE)
+
+        # speed the tick up for the test
+        import llmlb_tpu.gateway.update as upd
+
+        old_tick = upd.SCHEDULE_TICK_S
+        upd.SCHEDULE_TICK_S = 0.02
+        try:
+            mgr.set_schedule("on_idle")
+            mgr.start_background_tasks(check_interval_s=3600)
+            # busy: no apply
+            with gate.track():
+                await asyncio.sleep(0.1)
+                assert not applied
+            # idle: schedule fires
+            for _ in range(100):
+                if applied:
+                    break
+                await asyncio.sleep(0.02)
+            assert applied, "on_idle schedule never fired"
+
+            # at_time: fires once the clock passes
+            applied.clear()
+            mgr.available_version = "v2.1.0"
+            mgr._set_state(UpdateState.AVAILABLE)
+            mgr.set_schedule("at_time", time.time() + 0.15)
+            for _ in range(100):
+                if applied:
+                    break
+                await asyncio.sleep(0.02)
+            assert applied, "at_time schedule never fired"
+            assert mgr.schedule.mode == "immediate"  # one-shot reset
+        finally:
+            upd.SCHEDULE_TICK_S = old_tick
+            await mgr.stop_background_tasks()
+
+    asyncio.run(run())
+
+
+def test_check_failure_is_reported_not_raised(tmp_path):
+    async def run():
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            mgr = UpdateManager(
+                InferenceGate(),
+                source=GitHubUpdateSource(
+                    http, "acme/x", "1.0.0",
+                    api_base="http://127.0.0.1:1",  # nothing listening
+                ),
+            )
+            res = await mgr.check()
+            assert res["available"] is False
+            assert "error" in res
+
+    asyncio.run(run())
