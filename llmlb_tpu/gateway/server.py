@@ -78,10 +78,17 @@ async def run_server(config: ServerConfig | None = None) -> None:
         state.update_manager.post_restart_watch(self_health)
     )
 
+    hard_stop = asyncio.Event()
+
+    def on_signal() -> None:
+        if stop_event.is_set():
+            hard_stop.set()  # second signal: skip the graceful drain
+        stop_event.set()
+
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
-            loop.add_signal_handler(sig, stop_event.set)
+            loop.add_signal_handler(sig, on_signal)
         except NotImplementedError:
             pass
     try:
@@ -90,6 +97,29 @@ async def run_server(config: ServerConfig | None = None) -> None:
         log.info("shutting down")
         watch_task.cancel()
         await state.update_manager.stop_background_tasks()
+        # Drain in-flight inference before tearing the server down: with the
+        # 5 s shutdown grace above, an ordinary SIGTERM would otherwise cut
+        # long-running generations mid-stream. Skipped after a FORCE apply
+        # (its point is aborting wedged streams) and cut short by a second
+        # signal. A NORMAL update apply has already drained, so the wait
+        # returns immediately there.
+        from llmlb_tpu.gateway.update import ApplyMode
+
+        forced = getattr(
+            state.update_manager, "last_apply_mode", None
+        ) == ApplyMode.FORCE
+        state.gate.start_rejecting()
+        if not forced and not hard_stop.is_set():
+            drain = asyncio.ensure_future(state.gate.wait_for_idle(30.0))
+            bail = asyncio.ensure_future(hard_stop.wait())
+            done, pending = await asyncio.wait(
+                {drain, bail}, return_when=asyncio.FIRST_COMPLETED
+            )
+            for p in pending:
+                p.cancel()
+            if drain in done and not drain.result():
+                log.warning("shutdown drain timeout with %d in flight",
+                            state.gate.in_flight)
         await runner.cleanup()
         lock.release()
 

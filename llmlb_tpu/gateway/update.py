@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import enum
+import json
 import logging
 import os
 import tempfile
@@ -82,6 +83,7 @@ class UpdateManager:
         self.history: list[dict] = []
         self.last_check_at: float | None = None
         self._apply_task: asyncio.Task | None = None
+        self.last_apply_mode: ApplyMode | None = None
         self._bg_tasks: list[asyncio.Task] = []
 
     @classmethod
@@ -106,6 +108,12 @@ class UpdateManager:
             ),
         ) if repo else None
         applier = ArtifactSwapApplier(artifact) if artifact else None
+        if repo and not artifact:
+            log.warning(
+                "LLMLB_UPDATE_REPO is set but LLMLB_UPDATE_ARTIFACT is not: "
+                "update checks will run, but apply has nothing to swap and "
+                "will fail rather than pretend to succeed"
+            )
         return cls(
             gate, events, drain_timeout_s=drain_timeout_s,
             source=source, applier=applier, restart_cb=restart_cb,
@@ -146,24 +154,81 @@ class UpdateManager:
             return {"available": False, "error": str(e)}
         applying = self._apply_task is not None and not self._apply_task.done()
         if info and info.get("version"):
+            if info["version"] in self._blocked_versions():
+                log.warning(
+                    "release %s was rolled back on this host; not offering it "
+                    "again", info["version"],
+                )
+                if not applying and self.state == UpdateState.AVAILABLE:
+                    self._set_state(UpdateState.UP_TO_DATE)
+                return {"available": False, "blocked": info["version"]}
             self.available_version = info["version"]
             self.available_asset_url = info.get("asset_url")
+            self.error = None  # a successful check clears stale errors
             if not applying:  # never stomp DRAINING/APPLYING mid-apply
                 self._set_state(UpdateState.AVAILABLE)
             return {"available": True, **info}
         if not applying:
             self._set_state(UpdateState.UP_TO_DATE)
+            self.error = None
         return {"available": False}
 
-    async def download(self) -> str | None:
-        """Fetch the available asset to a staging path, publishing progress
-        events (update/mod.rs download-with-progress)."""
-        if self.source is None or not self.available_asset_url:
+    # A version that failed its post-restart health watch is remembered on
+    # disk so neither this process nor the restarted one re-offers it
+    # (reference rollback semantics; prevents an apply/rollback flip-flop).
+    def _blocklist_path(self) -> str | None:
+        if self.applier is None:
+            return None
+        return os.path.join(self.applier.state_dir, "update_blocklist.json")
+
+    def _blocked_versions(self) -> set[str]:
+        path = self._blocklist_path()
+        if not path:
+            return set()
+        try:
+            with open(path) as f:
+                return set(json.load(f))
+        except FileNotFoundError:
+            return set()
+        except (OSError, ValueError) as e:
+            log.warning("update blocklist at %s unreadable (%s); treating "
+                        "as empty", path, e)
+            return set()
+
+    def _block_version(self, version: str | None) -> None:
+        path = self._blocklist_path()
+        if not path or not version:
+            return
+        blocked = self._blocked_versions() | {version}
+        try:
+            # atomic: a crash mid-write must not leave a truncated file that
+            # silently reads back as an empty blocklist
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(sorted(blocked), f)
+            os.replace(tmp, path)
+        except OSError:
+            log.warning("could not persist update blocklist at %s", path)
+
+    _UNPINNED = object()  # sentinel: caller did not pin a release
+
+    async def download(self, version=_UNPINNED, asset_url=_UNPINNED):
+        """Fetch the asset to a staging path, publishing progress events
+        (update/mod.rs download-with-progress). Callers on the apply path
+        pass a pinned (version, asset_url) pair so a concurrent check()
+        discovering a newer release can't relabel in-flight bytes — pinned
+        values are authoritative, even when the pinned asset_url is None
+        (no fallback to mutable instance state)."""
+        if version is UpdateManager._UNPINNED:
+            version = self.available_version
+        if asset_url is UpdateManager._UNPINNED:
+            asset_url = self.available_asset_url
+        if self.source is None or not asset_url:
             return None
         # Cache is keyed by version: a staged download from a previous
         # release must never be applied under a newer version's label.
         if (self.downloaded_path
-                and self._downloaded_version == self.available_version
+                and self._downloaded_version == version
                 and os.path.isfile(self.downloaded_path)):
             return self.downloaded_path
         # Stage next to the artifact when possible (same filesystem, private
@@ -173,49 +238,72 @@ class UpdateManager:
             staging_dir = self.applier.state_dir
         else:
             staging_dir = tempfile.mkdtemp(prefix="llmlb-update-")
-        staging = os.path.join(
-            staging_dir, f"llmlb-update-{self.available_version}"
-        )
+        staging = os.path.join(staging_dir, f"llmlb-update-{version}")
 
         def progress(done: int, total: int) -> None:
             self.download_progress = {"done": done, "total": total}
-            if self.events and (total == 0 or done == total or
-                                done % (1 << 22) < (1 << 16)):
+            # Throttle: one event per ~4 MiB. total==0 (chunked encoding)
+            # must not bypass the throttle; the completion event below is
+            # published unconditionally once the transfer finishes.
+            if self.events and done % (1 << 22) < (1 << 16):
                 self.events.publish("UpdateDownloadProgress", {
-                    "version": self.available_version,
-                    "done": done, "total": total,
+                    "version": version, "done": done, "total": total,
                 })
 
         self.downloaded_path = await self.source.download(
-            self.available_asset_url, staging, progress_cb=progress
+            asset_url, staging, progress_cb=progress
         )
-        self._downloaded_version = self.available_version
+        self._downloaded_version = version
+        done = (self.download_progress or {}).get("done", 0)
+        self.download_progress = {"done": done, "total": done}
+        if self.events:
+            self.events.publish("UpdateDownloadProgress", {
+                "version": version, "done": done, "total": done,
+                "complete": True,
+            })
         return self.downloaded_path
 
     def request_apply(self, mode: ApplyMode = ApplyMode.NORMAL) -> bool:
         if self._apply_task and not self._apply_task.done():
             return False
+        self.last_apply_mode = mode
         self._apply_task = asyncio.create_task(self._apply_flow(mode))
         return True
 
     async def _apply_flow(self, mode: ApplyMode) -> None:
-        """drain → apply → (restart handled by hook). Reference §3.4 call stack."""
+        """download → drain → apply → (restart). Reference §3.4 call stack."""
         started = time.time()
-        # Fetch the asset BEFORE rejecting traffic: a slow multi-hundred-MB
-        # download must not extend the 503 window beyond the swap itself.
+        # Pin the release being applied: a concurrent check() discovering a
+        # newer version must not relabel this apply mid-flight.
+        version = self.available_version
+        asset_url = self.available_asset_url
+
+        def fail(msg: str) -> None:
+            self.error = msg
+            self.history.append({
+                "version": version, "mode": mode.value,
+                "started_at": started, "finished_at": time.time(),
+                "ok": False, "error": msg,
+            })
+            self._set_state(UpdateState.FAILED)
+
+        # Everything that can fail without touching traffic happens BEFORE
+        # the drain: the 503 window must cover only the swap itself.
         staged = None
-        if self.apply_hook is None and self.applier is not None:
-            try:
-                staged = await self.download()
-            except Exception as e:
-                self.error = str(e)
-                self.history.append({
-                    "version": self.available_version, "mode": mode.value,
-                    "started_at": started, "finished_at": time.time(),
-                    "ok": False, "error": str(e),
-                })
-                self._set_state(UpdateState.FAILED)
+        if self.apply_hook is None:
+            if self.applier is None:
+                fail("no apply mechanism configured "
+                     "(set LLMLB_UPDATE_ARTIFACT or an apply hook)")
                 return
+            try:
+                staged = await self.download(version, asset_url)
+            except Exception as e:
+                fail(str(e))
+                return
+            if staged is None:
+                fail(f"no downloadable asset for {version or 'update'}")
+                return
+
         self._set_state(UpdateState.DRAINING)
         self.gate.start_rejecting()  # /v1/* now 503 + Retry-After
         try:
@@ -229,34 +317,24 @@ class UpdateManager:
             self._set_state(UpdateState.APPLYING)
             if self.apply_hook is not None:
                 await self.apply_hook()
-            elif self.applier is not None:
-                if staged is None:
-                    raise RuntimeError(
-                        "no downloadable asset for "
-                        f"{self.available_version or 'update'}"
-                    )
-                self.applier.apply(staged, self.available_version)
+            else:
+                self.applier.apply(staged, version)
                 if self.restart_cb is not None:
                     r = self.restart_cb()
                     if asyncio.iscoroutine(r):
                         await r
             self.history.append({
-                "version": self.available_version,
+                "version": version,
                 "mode": mode.value,
                 "started_at": started,
                 "finished_at": time.time(),
                 "ok": True,
             })
             self._set_state(UpdateState.UP_TO_DATE)
+            self.error = None
             self.available_version = None
         except Exception as e:
-            self.error = str(e)
-            self.history.append({
-                "version": self.available_version, "mode": mode.value,
-                "started_at": started, "finished_at": time.time(),
-                "ok": False, "error": str(e),
-            })
-            self._set_state(UpdateState.FAILED)
+            fail(str(e))
         finally:
             self.gate.stop_rejecting()
 
@@ -370,6 +448,9 @@ class UpdateManager:
                 healthy_streak = 0
             await asyncio.sleep(interval_s)
         rolled = self.applier.rollback()
+        # Remember the bad release on disk: the restarted process (and this
+        # one) must not offer or re-apply it.
+        self._block_version(marker.get("version"))
         self.history.append({
             "version": marker.get("version"),
             "post_restart": "rolled_back" if rolled else "rollback_failed",

@@ -239,3 +239,81 @@ def test_check_failure_is_reported_not_raised(tmp_path):
             assert "error" in res
 
     asyncio.run(run())
+
+
+def test_rolled_back_version_is_not_reoffered(tmp_path):
+    """A release that failed its health watch is blocklisted on disk and
+    skipped by subsequent checks (no apply/rollback flip-flop)."""
+
+    async def run():
+        artifact = tmp_path / "app.bin"
+        artifact.write_bytes(b"BROKEN")
+        (tmp_path / "app.bin.bak").write_bytes(b"OLD")
+        applier = ArtifactSwapApplier(str(artifact))
+        applier.write_marker("v9.9.9")
+        mgr = UpdateManager(InferenceGate(), applier=applier)
+
+        async def never_healthy():
+            return False
+
+        out = await mgr.post_restart_watch(
+            never_healthy, watch_s=0.1, interval_s=0.02
+        )
+        assert out == "rolled_back"
+
+        # a fresh manager (simulated restart) must skip the bad release
+        async def offers_v999():
+            return {"version": "v9.9.9", "asset_url": "http://x/a"}
+
+        mgr2 = UpdateManager(InferenceGate(), applier=ArtifactSwapApplier(
+            str(artifact)), check_hook=offers_v999)
+        res = await mgr2.check()
+        assert res["available"] is False
+        assert res.get("blocked") == "v9.9.9"
+        assert mgr2.state == UpdateState.UP_TO_DATE
+
+    asyncio.run(run())
+
+
+def test_apply_without_asset_fails_before_draining(tmp_path):
+    """A release with no matching asset must fail fast, not 503 traffic."""
+
+    async def run():
+        import aiohttp
+
+        gh = await MockGitHub().start()
+        artifact = tmp_path / "app.bin"
+        artifact.write_bytes(b"OLD")
+        async with aiohttp.ClientSession() as http:
+            gate = InferenceGate()
+            src = GitHubUpdateSource(http, "acme/llmlb-tpu", "1.0.0",
+                                     asset_match="no-such-asset",
+                                     api_base=gh.api_base)
+            mgr = UpdateManager(gate, source=src,
+                                applier=ArtifactSwapApplier(str(artifact)))
+            res = await mgr.check()
+            assert res["available"] and res["asset_url"] is None
+            assert mgr.request_apply(ApplyMode.NORMAL)
+            await mgr._apply_task
+            assert mgr.state == UpdateState.FAILED
+            assert not gate.rejecting  # traffic was never drained
+            assert artifact.read_bytes() == b"OLD"
+            assert mgr.history[-1]["ok"] is False
+        await gh.stop()
+
+    asyncio.run(run())
+
+
+def test_apply_with_no_mechanism_is_recorded_as_failure():
+    async def run():
+        gate = InferenceGate()
+        mgr = UpdateManager(gate)  # no hook, no applier
+        mgr.available_version = "v2.0.0"
+        mgr._set_state(UpdateState.AVAILABLE)
+        assert mgr.request_apply(ApplyMode.NORMAL)
+        await mgr._apply_task
+        assert mgr.state == UpdateState.FAILED
+        assert "no apply mechanism" in (mgr.error or "")
+        assert not gate.rejecting
+
+    asyncio.run(run())
