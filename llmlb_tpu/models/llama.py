@@ -420,7 +420,7 @@ def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids
             ck = ck.at[slot_ids[:, None], write_pos].set(k.astype(ck.dtype))
             cv = cv.at[slot_ids[:, None], write_pos].set(v.astype(cv.dtype))
             return gqa_attention_extend(
-                q, ck[slot_ids], cv[slot_ids], positions
+                q, ck[slot_ids], cv[slot_ids], positions, chunk_lens
             )
 
         carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)

@@ -87,6 +87,7 @@ def gqa_attention_extend(
     k_cache: jnp.ndarray,  # [B, S, K, D] — slot cache incl. this chunk's keys
     v_cache: jnp.ndarray,  # [B, S, K, D]
     q_positions: jnp.ndarray,  # [B, T] int32 — global position of each query
+    chunk_lens: jnp.ndarray | None = None,  # [B] int32 — enables Pallas route
 ) -> jnp.ndarray:
     """Chunked-prefill attention: a chunk of T queries attends causally against
     the full slot cache (earlier chunks + this chunk). Query i at global
@@ -94,7 +95,16 @@ def gqa_attention_extend(
 
     Generalizes decode (T=1); backs the engine's chunked long-prompt prefill
     path (no reference counterpart — SURVEY.md §5 long-context is greenfield).
+    On a single TPU the Pallas flash kernel serves this; it assumes the
+    engine's contiguous chunk positions (q_positions[b] = start + iota), which
+    is what both callers construct.
     """
+    if chunk_lens is not None and _pallas_enabled():
+        from llmlb_tpu.ops.pallas_attention import flash_extend
+
+        return flash_extend(
+            q, k_cache, v_cache, q_positions[:, 0], chunk_lens
+        )
     b, t, h, d = q.shape
     k_heads = k_cache.shape[2]
     qg = _split_gqa(q, k_heads)  # [B, T, K, G, D]

@@ -320,3 +320,159 @@ def flash_prefill(
         interpret=interpret,
     )(prompt_lens.astype(jnp.int32), qg, k, v)
     return out.reshape(b, t, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Extend (chunked prefill): q chunk [B, T, H, D] vs slot cache [B, S, K, D],
+# chunk starts at global position start_pos[b] (contiguous positions).
+# ---------------------------------------------------------------------------
+
+
+def _extend_kernel(
+    # scalar prefetch
+    start_pos_ref,  # [B] int32 (SMEM) — global position of the chunk's 1st query
+    chunk_lens_ref,  # [B] int32 (SMEM) — valid queries in the chunk
+    # inputs
+    q_ref,  # [1, BLK_Q, K, G, D]
+    k_ref,  # [1, BLK_K, K, D]  (cache block)
+    v_ref,  # [1, BLK_K, K, D]
+    # output
+    o_ref,  # [1, BLK_Q, K, G, D]
+    # scratch
+    m_ref,  # [K, BLK_Q * G, 1] f32
+    l_ref,  # [K, BLK_Q * G, 1] f32
+    acc_ref,  # [K, BLK_Q * G, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    num_kv: int,
+    groups: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+    start = start_pos_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    rows = block_q * groups
+    # Skip KV blocks entirely in the future of every query in this Q block
+    # (query global positions are start + q_start .. start + q_start+BLK_Q-1),
+    # so extend cost scales with the context actually filled, not capacity;
+    # also skip Q blocks made entirely of padding rows (beyond chunk_lens) —
+    # their zero-initialized output is ignored by the caller.
+    useful = jnp.logical_and(
+        k_start <= start + q_start + block_q - 1,
+        q_start < chunk_lens_ref[b],
+    )
+
+    @pl.when(useful)
+    def _compute():
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), dimension=0)
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), dimension=1
+        )
+        q_pos = start + q_start + row // groups  # global position per query
+        mask = col <= q_pos
+        for h in range(num_kv):  # static unroll over KV heads
+            q = q_ref[0, :, h].reshape(rows, -1)  # [BLK_Q*G, D]
+            k = k_ref[0, :, h, :]  # [BLK_K, D]
+            v = v_ref[0, :, h, :]
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            scores = jnp.where(mask, scores, _NEG_INF)
+            _online_update(m_ref, l_ref, acc_ref, h, scores, v)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(num_kv, block_q, groups, -1).transpose(1, 0, 2, 3)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_extend(
+    q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
+    k_cache: jnp.ndarray,  # [B, S, K, D] — slot rows incl. this chunk's keys
+    v_cache: jnp.ndarray,  # [B, S, K, D]
+    start_pos: jnp.ndarray,  # [B] int32 — global position of the first query
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid queries (rest are padding)
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: T contiguous queries starting at global
+    position start_pos[b] attend causally over the slot cache (earlier chunks
+    + this chunk). Pallas counterpart of ops.attention.gqa_attention_extend
+    for the engine's long-prompt path. Returns [B, T, H, D] in q.dtype."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    s = k_cache.shape[1]
+    num_kv = k_cache.shape[2]
+    g = h // num_kv
+    blk_q = min(block_q, t)
+    blk_k = min(block_k, s)
+    grid = (b, pl.cdiv(t, blk_q), pl.cdiv(s, blk_k))
+    qg = q.reshape(b, t, num_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, blk_q, num_kv, g, d),
+                lambda bi, qi, si, starts, lens: (bi, qi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk_k, num_kv, d),
+                lambda bi, qi, si, starts, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, blk_k, num_kv, d),
+                lambda bi, qi, si, starts, lens: (bi, si, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, num_kv, g, d),
+            lambda bi, qi, si, starts, lens: (bi, qi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _extend_kernel,
+            block_q=blk_q,
+            block_k=blk_k,
+            num_kv=num_kv,
+            groups=g,
+            scale=d**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, t, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(start_pos.astype(jnp.int32), chunk_lens.astype(jnp.int32),
+      qg, k_cache, v_cache)
+    return out.reshape(b, t, h, d)

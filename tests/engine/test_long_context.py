@@ -169,3 +169,87 @@ def test_engine_long_prompt_streams_e2e(tiny_cfg):
         asyncio.run(run())
     finally:
         eng.shutdown()
+
+
+def test_cp_prefill_engine_matches_chunked(tiny_cfg):
+    """VERDICT r2 item 5: make_context_parallel_prefill wired into the engine.
+    A long prompt served on an sp>1 mesh (ring-attention one-shot prefill +
+    cache scatter) must produce the same greedy tokens as the single-device
+    chunked-prefill path."""
+    from llmlb_tpu.parallel.mesh import MeshConfig
+
+    cfg = tiny_cfg
+    rng = np.random.default_rng(3)
+    n = 40  # beyond the largest bucket below -> long-prompt path
+    prompt = list(rng.integers(1, cfg.vocab_size, size=(n,)))
+
+    def run(mesh_config):
+        core = EngineCore(
+            cfg, num_slots=2, slot_capacity=128,
+            prefill_buckets=(16, 32), seed=0, mesh_config=mesh_config,
+        )
+        if mesh_config is not None and mesh_config.sp > 1:
+            assert core._use_cp_prefill
+        core.start()
+        try:
+            req = Request(
+                prompt_ids=list(prompt),
+                sampling=SamplingParams(temperature=0.0, max_tokens=8),
+            )
+            core.submit(req)
+            toks = []
+            while True:
+                kind, val = req.events.get(timeout=120)
+                if kind == "token":
+                    toks.append(val)
+                elif kind == "done":
+                    break
+                else:
+                    raise AssertionError(f"engine error: {val}")
+            return toks
+        finally:
+            core.stop()
+
+    chunked = run(None)  # default dp x tp mesh: chunked path
+    cp = run(MeshConfig(dp=1, tp=2, sp=4))
+    assert chunked == cp, (chunked, cp)
+
+
+def test_prefill_fairness_round_robin(tiny_cfg):
+    """Two long prompts prefill concurrently: the second must start emitting
+    before the first finishes its whole decode (no head-of-line blocking)."""
+    core = EngineCore(
+        tiny_cfg, num_slots=2, slot_capacity=128,
+        prefill_buckets=(16,), seed=0,
+    )
+    core.start()
+    try:
+        rng = np.random.default_rng(4)
+        reqs = [
+            Request(
+                prompt_ids=list(rng.integers(1, tiny_cfg.vocab_size, size=(48,))),
+                sampling=SamplingParams(temperature=0.0, max_tokens=4),
+            )
+            for _ in range(2)
+        ]
+        for r in reqs:
+            core.submit(r)
+        # both must reach their first token; fairness means neither waits for
+        # the other's FULL prefill+decode to complete first
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        while any(r.first_token_at is None for r in reqs):
+            assert _time.monotonic() < deadline, "a prefill starved"
+            _time.sleep(0.01)
+        # drain
+        for r in reqs:
+            while True:
+                kind, _ = r.events.get(timeout=60)
+                if kind in ("done", "error"):
+                    break
+        gap = abs(reqs[0].first_token_at - reqs[1].first_token_at)
+        total = max(r.finished_at for r in reqs) - min(r.submitted_at for r in reqs)
+        assert gap < max(0.5 * total, 5.0), (gap, total)
+    finally:
+        core.stop()
