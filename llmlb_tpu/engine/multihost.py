@@ -1,0 +1,57 @@
+"""Leader-follower lockstep for multi-host serving.
+
+When a model is sharded across hosts (jax.distributed, SURVEY.md §2.4
+TPU-native equivalents), every jitted step is a cross-process collective:
+ALL processes must dispatch the same program in the same order or the
+cluster deadlocks. HTTP requests only arrive at one process, so the serving
+loop needs a control plane:
+
+- host 0 (leader) serves HTTP and owns the request queue;
+- each engine tick, the leader broadcasts a *plan* — new requests,
+  cancellations, shutdown — over the jax.distributed CPU mesh
+  (broadcast_one_to_all; rides DCN, not ICI);
+- every host then runs the identical scheduler logic on mirrored state, so
+  the sequence of device programs (prefill / chunk / decode / sample) is
+  identical everywhere, and the RNG key streams stay in lockstep because
+  they advance with the same ops from the same seed.
+
+The broadcast is two-phase (length, then padded payload) because
+broadcast_one_to_all needs identical shapes on every process while plans are
+variable-size. The reference has no counterpart — its "distributed backend"
+is HTTP between gateway and single-host runtimes.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+_MAX_PLAN_BYTES = 64 * 1024 * 1024  # sanity bound: a plan is requests, not data
+
+
+class StepCoordinator:
+    """Per-tick plan broadcast from the leader to every follower."""
+
+    def __init__(self):
+        self.num_hosts = jax.process_count()
+        self.is_leader = jax.process_index() == 0
+
+    def exchange(self, plan: dict | None) -> dict:
+        """Leader passes its plan (possibly empty); followers pass None.
+        Returns the leader's plan on every host. Blocking: this is the
+        synchronization point that keeps hosts in lockstep."""
+        from jax.experimental import multihost_utils as mhu
+
+        payload = pickle.dumps(plan) if self.is_leader else b""
+        if len(payload) > _MAX_PLAN_BYTES:
+            raise ValueError(f"tick plan too large: {len(payload)} bytes")
+        n = mhu.broadcast_one_to_all(
+            np.asarray([len(payload)], np.int64)
+        )
+        buf = np.zeros((int(n[0]),), np.uint8)
+        if self.is_leader:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        buf = mhu.broadcast_one_to_all(buf)
+        return pickle.loads(buf.tobytes())

@@ -159,6 +159,9 @@ class UpdateManager:
                     "release %s was rolled back on this host; not offering it "
                     "again", info["version"],
                 )
+                if self.available_version == info["version"]:
+                    self.available_version = None
+                    self.available_asset_url = None
                 if not applying and self.state == UpdateState.AVAILABLE:
                     self._set_state(UpdateState.UP_TO_DATE)
                 return {"available": False, "blocked": info["version"]}
@@ -286,9 +289,18 @@ class UpdateManager:
                 "ok": False, "error": msg,
             })
             self._set_state(UpdateState.FAILED)
+            # a failed FORCE apply must not leave shutdown drains disabled
+            self.last_apply_mode = None
 
         # Everything that can fail without touching traffic happens BEFORE
         # the drain: the 503 window must cover only the swap itself.
+        if version and version in self._blocked_versions():
+            fail(f"release {version} was rolled back on this host")
+            return
+        if self.applier is not None and self.applier.read_marker():
+            fail("previous update's post-restart health watch has not "
+                 "completed; not stacking another apply")
+            return
         staged = None
         if self.apply_hook is None:
             if self.applier is None:
@@ -396,6 +408,8 @@ class UpdateManager:
             try:
                 if self.state != UpdateState.AVAILABLE:
                     continue
+                if self.applier is not None and self.applier.read_marker():
+                    continue  # current update's health watch still pending
                 mode = self.schedule.mode
                 if mode == "on_idle" and self.gate.in_flight == 0:
                     log.info("on_idle schedule firing update apply")

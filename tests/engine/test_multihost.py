@@ -1,0 +1,67 @@
+"""Multi-host serving path: jax.distributed over 2 simulated hosts.
+
+VERDICT r2 item 8: a DCN-aware mesh (dp across hosts, ep/tp inside) running
+the Mixtral-class sharded step across a real 2-process jax.distributed
+cluster (CPU simulation; process boundary = DCN slice).
+"""
+
+from llmlb_tpu.parallel.distributed import build_hybrid_mesh, run_multihost_selftest
+from llmlb_tpu.parallel.mesh import MeshConfig
+
+
+def test_hybrid_mesh_single_slice_axes():
+    """Degenerate cluster (one slice): the helper still yields the standard
+    (dp, sp, ep, tp) axis layout. Multi-slice DCN splits require >=2
+    processes/slices and are covered by the spawned 2-host test below."""
+    mesh = build_hybrid_mesh(MeshConfig(dp=2, ep=2, tp=2), dcn_dp=1)
+    assert dict(mesh.shape) == {"dp": 2, "sp": 1, "ep": 2, "tp": 2}
+
+
+def test_two_host_cluster_runs_sharded_moe_step():
+    run_multihost_selftest(num_hosts=2, devices_per_host=4)
+
+
+def test_lockstep_engine_across_two_hosts_matches_single_host():
+    """Full serving loop across a 2-process cluster: the leader's tick-plan
+    broadcast keeps followers dispatching identical collectives; greedy
+    tokens must equal a single-host engine with the same seed/config."""
+    import numpy as np
+
+    from llmlb_tpu.engine.presets import get_preset
+    from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+
+    # single-host baseline with the identical config/seed/prompts
+    cfg = get_preset("debug-tiny")
+    core = EngineCore(cfg, num_slots=2, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    core.start()
+    try:
+        rng = np.random.default_rng(11)
+        reqs = [
+            Request(
+                prompt_ids=list(rng.integers(1, cfg.vocab_size, size=(12,))),
+                sampling=SamplingParams(temperature=0.0, max_tokens=6),
+            )
+            for _ in range(2)
+        ]
+        for r in reqs:
+            core.submit(r)
+        baseline = []
+        for r in reqs:
+            toks = []
+            while True:
+                kind, val = r.events.get(timeout=240)
+                if kind == "token":
+                    toks.append(int(val))
+                elif kind == "done":
+                    break
+                else:
+                    raise AssertionError(val)
+            baseline.append(toks)
+    finally:
+        core.stop()
+
+    distributed = run_multihost_selftest(
+        num_hosts=2, devices_per_host=4, mode="--engine-worker"
+    )
+    assert distributed == baseline, (distributed, baseline)
