@@ -144,15 +144,29 @@ class EngineCore:
 
         devices = jax.devices()
         if mesh_config is None:
-            n = len(devices)
+            # Size the latency-critical axes (ep, tp) within ONE slice/host —
+            # in a multi-process cluster their per-layer collectives must
+            # ride ICI, never DCN; dp (independent requests) spans hosts.
+            n_local = (jax.local_device_count()
+                       if jax.process_count() > 1 else len(devices))
             ep = 1
             if getattr(cfg, "num_experts", 0) > 1:
                 # MoE default: give experts as much of the mesh as divides both
                 # the device count and the expert count, tp/dp with the rest.
-                ep = math.gcd(n, cfg.num_experts)
-            tp = default_tp(n // ep, cfg.num_heads, cfg.num_kv_heads)
-            mesh_config = MeshConfig(dp=n // (ep * tp), ep=ep, tp=tp)
-        self.mesh = build_mesh(mesh_config, devices=devices)
+                ep = math.gcd(n_local, cfg.num_experts)
+            tp = default_tp(n_local // ep, cfg.num_heads, cfg.num_kv_heads)
+            mesh_config = MeshConfig(
+                dp=n_local // (ep * tp), ep=ep, tp=tp
+            )
+        if jax.process_count() > 1:
+            from llmlb_tpu.parallel.distributed import build_hybrid_mesh
+
+            # dp multiplies across slices over DCN; sp/ep/tp stay inside
+            self.mesh = build_hybrid_mesh(
+                mesh_config, dcn_dp=jax.process_count(), devices=devices
+            )
+        else:
+            self.mesh = build_mesh(mesh_config, devices=devices)
 
         if params is None:
             params = self.family.init_params(cfg, jax.random.PRNGKey(seed))
@@ -319,8 +333,16 @@ class EngineCore:
     def _collect_plan(self) -> dict:
         """Leader: drain intake + gather cancellations into this tick's plan.
         Requests cancelled before ever entering a plan are finished here
-        directly — no host (including this one) runs device ops for them."""
+        directly — no host (including this one) runs device ops for them.
+        The plan payload is bounded here, at collection: a too-large batch
+        spills to the next tick and an impossibly large single request is
+        failed with a terminal event — never by raising mid-broadcast, which
+        would desync the lockstep cluster."""
+        from llmlb_tpu.engine.multihost import _MAX_PLAN_BYTES
+
+        budget = _MAX_PLAN_BYTES // 8  # ~int32 tokens, pickled with overhead
         new = []
+        tokens = 0
         while True:
             try:
                 req = self._intake.get_nowait()
@@ -329,6 +351,14 @@ class EngineCore:
             if req.cancelled:
                 req.events.put(("done", "cancelled"))
                 continue
+            n = len(req.prompt_ids)
+            if n > budget:
+                req.events.put(("error", "prompt too large for a tick plan"))
+                continue
+            if tokens + n > budget:
+                self._intake.put(req)  # next tick; order within intake kept
+                break
+            tokens += n
             new.append(req)
         cancelled = []
         in_flight = [s.request for s in self.slots if s.request is not None]

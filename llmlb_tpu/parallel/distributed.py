@@ -158,6 +158,38 @@ def _selftest_worker(process_id: int, num_hosts: int, port: int,
         )
 
 
+def selftest_requests(cfg):
+    """The canonical request set for engine lockstep equivalence checks —
+    shared by _engine_worker and the single-host baseline in tests so the
+    comparison stays structural, not copy-paste."""
+    from llmlb_tpu.engine.scheduler import Request, SamplingParams
+
+    rng = np.random.default_rng(11)
+    return [
+        Request(
+            prompt_ids=list(rng.integers(1, cfg.vocab_size, size=(12,))),
+            sampling=SamplingParams(temperature=0.0, max_tokens=6),
+        )
+        for _ in range(2)
+    ]
+
+
+def collect_tokens(reqs, timeout: float = 240.0) -> list[list[int]]:
+    outs = []
+    for r in reqs:
+        toks = []
+        while True:
+            kind, val = r.events.get(timeout=timeout)
+            if kind == "token":
+                toks.append(int(val))
+            elif kind == "done":
+                break
+            else:
+                raise AssertionError(f"engine error: {val}")
+        outs.append(toks)
+    return outs
+
+
 def _engine_worker(process_id: int, num_hosts: int, port: int,
                    devices_per_host: int) -> None:
     """Lockstep serving across hosts: every process builds the same
@@ -171,7 +203,7 @@ def _engine_worker(process_id: int, num_hosts: int, port: int,
         process_id=process_id,
     )
     from llmlb_tpu.engine.presets import get_preset
-    from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+    from llmlb_tpu.engine.scheduler import EngineCore
 
     cfg = get_preset("debug-tiny")
     core = EngineCore(cfg, num_slots=2, slot_capacity=64,
@@ -182,28 +214,10 @@ def _engine_worker(process_id: int, num_hosts: int, port: int,
     core.start()
     if process_id == 0:
         try:
-            rng = np.random.default_rng(11)
-            reqs = [
-                Request(
-                    prompt_ids=list(rng.integers(1, cfg.vocab_size, size=(12,))),
-                    sampling=SamplingParams(temperature=0.0, max_tokens=6),
-                )
-                for _ in range(2)
-            ]
+            reqs = selftest_requests(cfg)
             for r in reqs:
                 core.submit(r)
-            outs = []
-            for r in reqs:
-                toks = []
-                while True:
-                    kind, val = r.events.get(timeout=240)
-                    if kind == "token":
-                        toks.append(int(val))
-                    elif kind == "done":
-                        break
-                    else:
-                        raise AssertionError(f"engine error: {val}")
-                outs.append(toks)
+            outs = collect_tokens(reqs)
             print(f"ENGINE_TOKENS {outs!r}", flush=True)
         finally:
             core.stop()  # broadcasts shutdown; followers exit their loops
@@ -227,37 +241,58 @@ def run_multihost_selftest(num_hosts: int = 2, devices_per_host: int = 4,
     import subprocess
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    def fresh_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
 
-    procs = []
+    import time as _time
+
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices_per_host}"
     env.pop("PYTHONSTARTUP", None)
-    for pid in range(num_hosts):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "llmlb_tpu.parallel.distributed",
-             mode, str(pid), str(num_hosts), str(port),
-             str(devices_per_host)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
-        ))
-    import time as _time
+
+    def spawn_round() -> list:
+        port = fresh_port()
+        return [
+            subprocess.Popen(
+                [sys.executable, "-m", "llmlb_tpu.parallel.distributed",
+                 mode, str(pid), str(num_hosts), str(port),
+                 str(devices_per_host)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+            for pid in range(num_hosts)
+        ]
 
     deadline = _time.monotonic() + timeout_s  # shared: the whole cluster
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(
-                timeout=max(1.0, deadline - _time.monotonic())
-            )
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise RuntimeError("multihost selftest timed out")
-        outs.append((p.returncode, out, err))
+    # The bind-then-close port probe is racy (another process can claim the
+    # port before the coordinator binds it) — retry with a fresh port when
+    # the failure is the coordinator bind, not the code under test.
+    for attempt in range(3):
+        procs = spawn_round()
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(
+                    timeout=max(1.0, deadline - _time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise RuntimeError("multihost selftest timed out")
+            outs.append((p.returncode, out, err))
+        failures = [(rc, err) for rc, _, err in outs if rc != 0]
+        bind_race = any(
+            "address already in use" in err.lower()
+            or "failed to bind" in err.lower()
+            for _, err in failures
+        )
+        if failures and bind_race and attempt < 2:
+            log.warning("coordinator port race; retrying with a fresh port")
+            continue
+        break
     for rc, out, err in outs:
         if rc != 0:
             raise RuntimeError(

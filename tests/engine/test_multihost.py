@@ -25,39 +25,21 @@ def test_lockstep_engine_across_two_hosts_matches_single_host():
     """Full serving loop across a 2-process cluster: the leader's tick-plan
     broadcast keeps followers dispatching identical collectives; greedy
     tokens must equal a single-host engine with the same seed/config."""
-    import numpy as np
-
     from llmlb_tpu.engine.presets import get_preset
-    from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+    from llmlb_tpu.engine.scheduler import EngineCore
+    from llmlb_tpu.parallel.distributed import collect_tokens, selftest_requests
 
-    # single-host baseline with the identical config/seed/prompts
+    # single-host baseline: the SAME request builder the distributed worker
+    # uses, so the equivalence is structural
     cfg = get_preset("debug-tiny")
     core = EngineCore(cfg, num_slots=2, slot_capacity=64,
                       prefill_buckets=(16,), seed=0)
     core.start()
     try:
-        rng = np.random.default_rng(11)
-        reqs = [
-            Request(
-                prompt_ids=list(rng.integers(1, cfg.vocab_size, size=(12,))),
-                sampling=SamplingParams(temperature=0.0, max_tokens=6),
-            )
-            for _ in range(2)
-        ]
+        reqs = selftest_requests(cfg)
         for r in reqs:
             core.submit(r)
-        baseline = []
-        for r in reqs:
-            toks = []
-            while True:
-                kind, val = r.events.get(timeout=240)
-                if kind == "token":
-                    toks.append(int(val))
-                elif kind == "done":
-                    break
-                else:
-                    raise AssertionError(val)
-            baseline.append(toks)
+        baseline = collect_tokens(reqs)
     finally:
         core.stop()
 

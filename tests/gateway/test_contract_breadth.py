@@ -1,0 +1,231 @@
+"""Contract breadth (VERDICT r2 item 10): queueing edges, streaming
+abort/Drop safety, and the full per-scope API-key permission matrix.
+
+Parity targets: reference tests/contract/queueing_test.rs behaviors,
+api/proxy.rs Drop-safe lease finalization, common/auth.rs permission scopes.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+# ------------------------------------------------------------- queueing edges
+
+
+def _tune_queue(gw, **overrides) -> None:
+    import dataclasses
+
+    lm = gw.state.load_manager
+    lm.queue_config = dataclasses.replace(lm.queue_config, **overrides)
+
+
+def test_queue_timeout_503_reports_position():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m", reply_delay_s=2.0).start()
+        try:
+            gw.register_mock(mock.url, ["m"])
+            _tune_queue(gw, max_active_per_endpoint=1, queue_timeout_s=0.3)
+            headers = await gw.inference_headers()
+
+            async def one():
+                return await gw.client.post("/v1/chat/completions", json={
+                    "model": "m", "messages": [{"role": "user", "content": "x"}],
+                }, headers=headers)
+
+            first = asyncio.create_task(one())
+            await asyncio.sleep(0.1)  # occupies the only slot
+            second = await one()
+            assert second.status == 503
+            body = await second.json()
+            assert "position" in body["error"]["message"]
+            r1 = await first
+            assert r1.status == 200
+        finally:
+            await mock.stop()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_queued_request_admits_when_slot_frees():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m", reply_delay_s=0.4).start()
+        try:
+            gw.register_mock(mock.url, ["m"])
+            _tune_queue(gw, max_active_per_endpoint=1, queue_timeout_s=10.0)
+            headers = await gw.inference_headers()
+
+            async def one():
+                r = await gw.client.post("/v1/chat/completions", json={
+                    "model": "m", "messages": [{"role": "user", "content": "x"}],
+                }, headers=headers)
+                assert r.status == 200, await r.text()
+
+            await asyncio.gather(*(one() for _ in range(3)))
+            # all three landed on the endpoint, strictly serialized
+            assert len(mock.requests_seen) == 3
+            assert gw.state.load_manager.total_active() == 0
+        finally:
+            await mock.stop()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------- streaming abort safety
+
+
+class HangingStreamEndpoint(MockOpenAIEndpoint):
+    """Streams one chunk then stalls until cancelled — a wedged upstream."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.aborted = asyncio.Event()
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/v1/chat/completions", self._hang)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def _hang(self, request):
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+        await resp.write(b'data: {"choices":[{"index":0,'
+                         b'"delta":{"content":"x"}}]}\n\n')
+        try:
+            await asyncio.sleep(3600)
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.aborted.set()
+            raise
+        return resp
+
+
+def test_client_abort_mid_stream_releases_lease():
+    """Drop safety (api/proxy.rs:186-204 parity): a client vanishing mid-SSE
+    must release the endpoint's active slot so later requests are admitted."""
+
+    async def run():
+        gw = await GatewayHarness.create()
+        hang = await HangingStreamEndpoint(model="m").start()
+        fast = await MockOpenAIEndpoint(model="m").start()
+        try:
+            ep = gw.register_mock(hang.url, ["m"], name="hang")
+            _tune_queue(gw, max_active_per_endpoint=1)
+            headers = await gw.inference_headers()
+
+            async def aborted_stream():
+                resp = await gw.client.post("/v1/chat/completions", json={
+                    "model": "m", "stream": True,
+                    "messages": [{"role": "user", "content": "x"}],
+                }, headers=headers)
+                assert resp.status == 200
+                await resp.content.read(10)  # first bytes arrive...
+                resp.close()  # ...then the client drops the connection
+
+            await aborted_stream()
+            await asyncio.wait_for(hang.aborted.wait(), timeout=10)
+            # the lease must drain back to zero so the slot is reusable
+            for _ in range(100):
+                if gw.state.load_manager.active_count(ep.id) == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert gw.state.load_manager.active_count(ep.id) == 0
+        finally:
+            await hang.stop()
+            await fast.stop()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------- per-scope permission matrix
+
+# (method, path, body) probes per permission scope; each must be allowed with
+# the scope and denied without it (403), mirroring common/auth.rs:59-97.
+_MATRIX = [
+    ("openai.inference", "POST", "/v1/chat/completions",
+     {"model": "m", "messages": [{"role": "user", "content": "x"}]}),
+    ("openai.models.read", "GET", "/v1/models", None),
+    ("endpoints.read", "GET", "/api/endpoints", None),
+    ("endpoints.manage", "POST", "/api/endpoints",
+     {"base_url": "http://127.0.0.1:9", "endpoint_type": "openai_compatible"}),
+    ("logs.read", "GET", "/api/audit-log", None),
+    ("logs.read", "GET", "/api/dashboard/logs/lb", None),
+    ("metrics.read", "GET", "/api/dashboard/overview", None),
+    ("metrics.read", "GET", "/api/metrics/cloud", None),
+    ("registry.read", "GET", "/api/models/registry/some-model/manifest.json",
+     None),
+    ("invitations.manage", "POST", "/api/invitations", {"role": "viewer"}),
+    ("users.manage", "GET", "/api/users", None),
+]
+
+
+@pytest.mark.parametrize("perm,method,path,body", _MATRIX)
+def test_api_key_permission_matrix(perm, method, path, body):
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(mock.url, ["m"])
+            admin = await gw.admin_headers()
+
+            async def key_with(perms: list[str]) -> dict:
+                resp = await gw.client.post(
+                    "/api/api-keys", json={"name": "k", "permissions": perms},
+                    headers=admin,
+                )
+                assert resp.status == 201
+                return {
+                    "Authorization":
+                        f"Bearer {(await resp.json())['api_key']}"
+                }
+
+            granted = await key_with([perm])
+            resp = await gw.client.request(
+                method, path, json=body, headers=granted
+            )
+            # may 404/502 on missing data, but NEVER 401/403
+            assert resp.status not in (401, 403), (
+                perm, path, resp.status, await resp.text()
+            )
+
+            # a disjoint scope must be denied
+            other = ("metrics.read" if perm != "metrics.read"
+                     else "endpoints.read")
+            denied = await key_with([other])
+            resp = await gw.client.request(
+                method, path, json=body, headers=denied
+            )
+            assert resp.status == 403, (perm, path, resp.status)
+        finally:
+            await mock.stop()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_inference_scope_grants_models_read():
+    """openai.inference implies the models listing (reference behavior:
+    inference keys can discover what to call)."""
+
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            headers = await gw.inference_headers()
+            resp = await gw.client.get("/v1/models", headers=headers)
+            assert resp.status == 200
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
