@@ -1,0 +1,134 @@
+"""Engine Prometheus metrics: counters, gauges, and latency histograms.
+
+The reference exposes Prometheus text only for cloud-proxy calls
+(cloud_metrics.rs:21-39); the tpu:// engine goes further and instruments the
+serving loop itself — TTFT and inter-token latency histograms, token/request
+counters, queue depth — because those are the numbers a TPU serving operator
+tunes against (and what the gateway's telemetry-aware scheduler ultimately
+reflects). Dependency-free text exposition; threadsafe for the step loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Bucket edges in seconds, chosen around serving targets: TTFT p50 goals are
+# tens of ms (one-shot prefill) to seconds (chunked 4k prompts); ITL goals
+# are single-digit ms on TPU.
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+
+
+class Histogram:
+    def __init__(self, buckets: tuple[float, ...]):
+        self.edges = tuple(buckets)
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        for i, edge in enumerate(self.edges):
+            if value <= edge:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.total += value
+        self.n += 1
+
+    def percentile(self, pct: float) -> float | None:
+        """Approximate percentile from bucket upper edges (None if empty)."""
+        if self.n == 0:
+            return None
+        target = self.n * pct / 100.0
+        seen = 0
+        for i, edge in enumerate(self.edges):
+            seen += self.counts[i]
+            if seen >= target:
+                return edge
+        return float("inf")
+
+
+class EngineMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.tokens_total = 0
+        self.errors_total = 0
+        self.cancelled_total = 0
+        self.ttft = Histogram(TTFT_BUCKETS)
+        self.itl = Histogram(ITL_BUCKETS)
+
+    # ------------------------------------------------------------ recorders
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft.observe(seconds)
+
+    def record_itl(self, seconds: float) -> None:
+        with self._lock:
+            self.itl.observe(seconds)
+
+    def record_token(self, n: int = 1) -> None:
+        with self._lock:
+            self.tokens_total += n
+
+    def record_request_done(self, finish: str) -> None:
+        with self._lock:
+            self.requests_total += 1
+            if finish == "cancelled":
+                self.cancelled_total += 1
+            elif finish == "error":
+                self.errors_total += 1
+
+    # ----------------------------------------------------------- exposition
+
+    def summary(self) -> dict:
+        """Compact JSON figures for /api/health consumers (the gateway's
+        scheduler and dashboard)."""
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "tokens_total": self.tokens_total,
+                "errors_total": self.errors_total,
+                "cancelled_total": self.cancelled_total,
+                "ttft_p50_s": self.ttft.percentile(50),
+                "ttft_p99_s": self.ttft.percentile(99),
+                "itl_p50_s": self.itl.percentile(50),
+                "itl_p99_s": self.itl.percentile(99),
+            }
+
+    def render(self, *, queue_depth: int, active_slots: int,
+               num_slots: int) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            lines = [
+                "# TYPE llmlb_engine_requests_total counter",
+                f"llmlb_engine_requests_total {self.requests_total}",
+                "# TYPE llmlb_engine_tokens_total counter",
+                f"llmlb_engine_tokens_total {self.tokens_total}",
+                "# TYPE llmlb_engine_errors_total counter",
+                f"llmlb_engine_errors_total {self.errors_total}",
+                "# TYPE llmlb_engine_cancelled_total counter",
+                f"llmlb_engine_cancelled_total {self.cancelled_total}",
+                "# TYPE llmlb_engine_queue_depth gauge",
+                f"llmlb_engine_queue_depth {queue_depth}",
+                "# TYPE llmlb_engine_active_slots gauge",
+                f"llmlb_engine_active_slots {active_slots}",
+                "# TYPE llmlb_engine_num_slots gauge",
+                f"llmlb_engine_num_slots {num_slots}",
+            ]
+            for name, hist in (("llmlb_engine_ttft_seconds", self.ttft),
+                               ("llmlb_engine_itl_seconds", self.itl)):
+                lines.append(f"# TYPE {name} histogram")
+                cumulative = 0
+                for i, edge in enumerate(hist.edges):
+                    cumulative += hist.counts[i]
+                    lines.append(
+                        f'{name}_bucket{{le="{edge}"}} {cumulative}'
+                    )
+                cumulative += hist.counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
+                lines.append(f"{name}_sum {hist.total}")
+                lines.append(f"{name}_count {hist.n}")
+            return "\n".join(lines) + "\n"

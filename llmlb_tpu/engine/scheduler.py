@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llmlb_tpu.engine.metrics import EngineMetrics
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
@@ -104,6 +105,7 @@ class _Slot:
     # the chunks are filling.
     prefilling: bool = False
     prefill_pos: int = 0
+    last_emit_at: float = 0.0  # inter-token latency tracking
 
 
 @dataclasses.dataclass(frozen=True)
@@ -212,6 +214,7 @@ class EngineCore:
             )
             # leader-only intake; mirrored into self.pending via the plan
             self._intake: queue.SimpleQueue[Request] = queue.SimpleQueue()
+            self._plan_backlog: list[Request] = []  # budget-spilled, FIFO
             # Cancellations take effect ONLY via the plan in multihost mode:
             # the live .cancelled flag flips at arbitrary times on the leader
             # (HTTP thread), and acting on it directly would make hosts
@@ -239,6 +242,7 @@ class EngineCore:
         # snapshots .queue to find cancelled-but-still-queued requests;
         # in that mode the loop thread is both producer and consumer.
         self.pending: queue.Queue[Request] = queue.Queue()
+        self.metrics = EngineMetrics()
         self._running = False
         self._thread: threading.Thread | None = None
         self._started_at = time.monotonic()
@@ -341,22 +345,28 @@ class EngineCore:
         from llmlb_tpu.engine.multihost import _MAX_PLAN_BYTES
 
         budget = _MAX_PLAN_BYTES // 8  # ~int32 tokens, pickled with overhead
-        new = []
-        tokens = 0
+        candidates = self._plan_backlog
+        self._plan_backlog = []
         while True:
             try:
-                req = self._intake.get_nowait()
+                candidates.append(self._intake.get_nowait())
             except queue.Empty:
                 break
+        new = []
+        tokens = 0
+        for idx, req in enumerate(candidates):
             if req.cancelled:
                 req.events.put(("done", "cancelled"))
                 continue
             n = len(req.prompt_ids)
             if n > budget:
                 req.events.put(("error", "prompt too large for a tick plan"))
+                self.metrics.record_request_done("error")
                 continue
             if tokens + n > budget:
-                self._intake.put(req)  # next tick; order within intake kept
+                # spill THIS and everything behind it to the next tick's
+                # backlog — arrival order is preserved, no starvation
+                self._plan_backlog = candidates[idx:]
                 break
             tokens += n
             new.append(req)
@@ -471,6 +481,7 @@ class EngineCore:
         room = self.slot_capacity - n - 1
         if room <= 0:
             request.events.put(("error", "prompt does not fit slot capacity"))
+            self.metrics.record_request_done("error")
             return True
 
         slot = self.slots[slot_id]
@@ -621,6 +632,10 @@ class EngineCore:
         self._d_last_tokens = self._d_last_tokens.at[slot_id].set(first)
 
         request.first_token_at = time.monotonic()
+        self.metrics.record_ttft(request.first_token_at - request.submitted_at)
+        # last_emit_at starts 0 so the FIRST token records no inter-token
+        # latency (_emit sets it for the tokens that follow)
+        self.slots[slot_id].last_emit_at = 0.0
         self._emit(slot_id, int(first))
 
     def _decode_active(self) -> bool:
@@ -659,11 +674,18 @@ class EngineCore:
         if self._is_cancelled(request):
             request.finished_at = time.monotonic()
             request.events.put(("done", "cancelled"))
+            self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
             slot.request = None
             slot.generated = 0
+            slot.last_emit_at = 0.0
             return
         slot.generated += 1
+        now = time.monotonic()
+        if slot.last_emit_at:
+            self.metrics.record_itl(now - slot.last_emit_at)
+        slot.last_emit_at = now
+        self.metrics.record_token()
         with self._lock:
             self.total_tokens += 1
 
@@ -683,19 +705,24 @@ class EngineCore:
         if finish is not None:
             request.finished_at = time.monotonic()
             request.events.put(("done", finish))
+            self.metrics.record_request_done(finish)
             slot.request = None
             slot.generated = 0
+            slot.last_emit_at = 0.0
 
     def _fail_all(self, message: str) -> None:
         for slot in self.slots:
             if slot.request is not None:
                 slot.request.events.put(("error", message))
+                self.metrics.record_request_done("error")
                 slot.request = None
             slot.prefilling = False
             slot.prefill_pos = 0
             slot.generated = 0
+            slot.last_emit_at = 0.0
         while True:
             try:
                 self.pending.get_nowait().events.put(("error", message))
+                self.metrics.record_request_done("error")
             except queue.Empty:
                 break

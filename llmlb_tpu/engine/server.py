@@ -236,6 +236,19 @@ class EngineAPI:
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response(self.engine.health())
 
+    async def prometheus_metrics(self, request: web.Request) -> web.Response:
+        """GET /metrics — Prometheus exposition of the serving loop
+        (TTFT/ITL histograms, token/request counters, queue depth)."""
+        core = self.engine.core
+        stats = core.stats()
+        text = core.metrics.render(
+            queue_depth=stats.queued, active_slots=stats.active_slots,
+            num_slots=stats.num_slots,
+        )
+        return web.Response(
+            text=text, content_type="text/plain", charset="utf-8"
+        )
+
     async def system(self, request: web.Request) -> web.Response:
         return web.json_response(
             {
@@ -584,6 +597,7 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     app.router.add_post("/v1/audio/speech", api.audio_speech)
     app.router.add_post("/v1/images/generations", api.images_generations)
     app.router.add_get("/api/health", api.health)
+    app.router.add_get("/metrics", api.prometheus_metrics)
     app.router.add_get("/api/system", api.system)
 
     if owns_engine:

@@ -289,6 +289,7 @@ class Engine:
                 "mesh": dict(self.core.mesh.shape),
             },
             "tpu": device_telemetry(),
+            "metrics": self.core.metrics.summary(),
         }
 
 

@@ -246,6 +246,20 @@ async def download_endpoint_model(request: web.Request) -> web.Response:
         try:
             if ep.endpoint_type == EndpointType.OLLAMA:
                 path, payload = "/api/pull", {"name": model, "stream": False}
+            elif ep.endpoint_type == EndpointType.LM_STUDIO:
+                # LM Studio wants a HF URL (download/lm_studio.rs:52-62)
+                from llmlb_tpu.gateway.model_names import guess_hf_repo
+
+                repo = guess_hf_repo(model) or model
+                hf_url = (repo if repo.startswith("https://")
+                          else f"https://huggingface.co/{repo}")
+                path, payload = "/api/v1/models/download", {"model": hf_url}
+            elif ep.endpoint_type == EndpointType.XLLM:
+                # xLLM pulls from HF by repo id (xllm/download.rs:87)
+                from llmlb_tpu.gateway.model_names import guess_hf_repo
+
+                path = "/api/models/download"
+                payload = {"model": guess_hf_repo(model) or model}
             else:
                 path, payload = "/api/models/download", {"model": model}
             headers = {}

@@ -39,7 +39,65 @@ _KNOWN: dict[str, dict[str, str]] = {
     "openai/gpt-oss-20b": {
         "ollama": "gpt-oss:20b",
     },
+    "meta-llama/Llama-3.2-1B-Instruct": {
+        "ollama": "llama3.2:1b",
+        "tpu": "llama-3.2-1b",
+    },
+    "meta-llama/Llama-3.2-3B-Instruct": {
+        "ollama": "llama3.2:3b",
+    },
+    "meta-llama/Llama-2-7b-chat-hf": {
+        "ollama": "llama2:7b",
+    },
+    "mistralai/Mixtral-8x7B-Instruct-v0.1": {
+        "ollama": "mixtral:8x7b",
+        "tpu": "mixtral-8x7b",
+    },
+    "Qwen/Qwen2.5-7B-Instruct": {
+        "ollama": "qwen2.5:7b",
+    },
+    "Qwen/Qwen2.5-Coder-7B-Instruct": {
+        "ollama": "qwen2.5-coder:7b",
+    },
+    "google/gemma-2-9b-it": {
+        "ollama": "gemma2:9b",
+    },
+    "microsoft/Phi-3-mini-4k-instruct": {
+        "ollama": "phi3:mini",
+    },
+    "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B": {
+        "ollama": "deepseek-r1:7b",
+    },
+    "TinyLlama/TinyLlama-1.1B-Chat-v1.0": {
+        "ollama": "tinyllama:1.1b",
+        "tpu": "tinyllama-1.1b",
+    },
+    "BAAI/bge-m3": {
+        "ollama": "bge-m3",
+    },
+    "nomic-ai/nomic-embed-text-v1.5": {
+        "ollama": "nomic-embed-text",
+    },
 }
+
+# family token -> HF org, for repo guessing on unknown names
+# (same job as the reference's HF-repo guess tables, models/mapping.rs)
+_FAMILY_ORGS = [
+    ("llama", "meta-llama"),
+    ("tinyllama", "TinyLlama"),
+    ("mixtral", "mistralai"),
+    ("mistral", "mistralai"),
+    ("qwen", "Qwen"),
+    ("gemma", "google"),
+    ("phi", "microsoft"),
+    ("deepseek", "deepseek-ai"),
+    ("whisper", "openai"),
+    ("gpt-oss", "openai"),
+    ("stable-diffusion", "stabilityai"),
+    ("sdxl", "stabilityai"),
+    ("bge", "BAAI"),
+    ("nomic-embed", "nomic-ai"),
+]
 
 _ALIAS_TO_CANONICAL: dict[str, str] = {}
 for canonical, aliases in _KNOWN.items():
@@ -81,9 +139,43 @@ def to_engine_name(canonical: str, endpoint_type: str) -> str:
     return canonical
 
 
+def parse_engine_tag(name: str) -> dict:
+    """Decompose an engine-style name ('llama3.1:8b-instruct-q4_K_M' or a
+    GGUF filename) into family / size / variant / quant — the shape the
+    reference's quant-suffix parser produces (api/model_name.rs)."""
+    base = name
+    if base.lower().endswith(".gguf"):
+        base = base[:-5]
+    quant = None
+    m = _QUANT_SUFFIX.search(base)
+    if m:
+        quant = m.group(1)
+        base = strip_quant_suffix(base)
+    family, _, tag = base.partition(":")
+    size = None
+    variant = []
+    for part in re.split(r"[-_.]", tag) if tag else []:
+        if re.fullmatch(r"\d+(\.\d+)?[bBmM]", part):
+            size = part.lower()
+        elif part:
+            variant.append(part.lower())
+    return {
+        "family": family.lower(),
+        "size": size,
+        "variant": "-".join(variant) or None,
+        "quant": quant.lower() if quant else None,
+    }
+
+
 def guess_hf_repo(name: str) -> str | None:
-    """Best-effort HF repo id for a bare model name (catalog helper)."""
+    """Best-effort HF repo id for a bare model name: exact/alias table first,
+    then family→org heuristics (catalog + download-flow helper)."""
     canonical = to_canonical(name)
     if "/" in canonical:
         return canonical
+    lowered = strip_quant_suffix(canonical.lower().removesuffix(".gguf"))
+    for token, org in _FAMILY_ORGS:
+        if lowered.startswith(token) or f"-{token}" in lowered:
+            bare = lowered.replace(":", "-")
+            return f"{org}/{bare}"
     return None

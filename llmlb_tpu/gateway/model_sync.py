@@ -119,7 +119,12 @@ async def sync_endpoint_models(
     timeout: float = 10.0,
 ) -> tuple[int, int]:
     """Returns (added, removed) vs the previous registry state."""
+    from llmlb_tpu.gateway.engine_metadata import enrich_context_lengths
+
     models = await fetch_endpoint_models(endpoint, session, timeout)
+    # per-engine metadata probes (Ollama /api/show etc.) fill in context
+    # lengths the /v1/models listing did not carry
+    await enrich_context_lengths(endpoint, models, session)
     before = {m.model_id for m in registry.models_for(endpoint.id)}
     after = {m.model_id for m in models}
     registry.sync_models(endpoint.id, models)

@@ -135,8 +135,16 @@ def main(argv: list[str] | None = None) -> None:
     sub.add_parser("status", help="check whether a gateway is running")
     stop = sub.add_parser("stop", help="stop a running gateway")
     stop.add_argument("--port", type=int, default=None)
+    assistant = sub.add_parser(
+        "assistant", help="API helper: sanitized curl, openapi, guides"
+    )
+    assistant.add_argument("assistant_args", nargs=argparse.REMAINDER)
 
     args = parser.parse_args(argv)
+    if args.command == "assistant":
+        from llmlb_tpu.gateway.assistant import main as assistant_main
+
+        raise SystemExit(assistant_main(args.assistant_args))
     from llmlb_tpu.gateway.logging_setup import init_logging
 
     # stderr + daily-rotated file sink (reference logging.rs:41-182)

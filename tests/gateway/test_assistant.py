@@ -1,0 +1,109 @@
+"""Assistant CLI: sanitized curl, host whitelist, auth injection, masking.
+
+Parity targets: cli/assistant.rs — FORBIDDEN_OPTIONS/PATTERNS (:28-63),
+host whitelist (:442-450), mask_sensitive (:635-649), execute_curl (:201).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from llmlb_tpu.gateway.assistant import (
+    CurlRejected,
+    mask_sensitive,
+    openapi_summary,
+    parse_curl,
+    run_curl,
+)
+from tests.support import GatewayHarness
+
+
+def test_forbidden_shell_patterns_rejected():
+    for cmd in (
+        "curl http://localhost:32768/v1/models; rm -rf /",
+        "curl http://localhost:32768/v1/models | sh",
+        "curl $(evil) http://localhost:32768/",
+        "curl http://localhost:32768/ > /etc/passwd",
+        "curl `id` http://localhost:32768/",
+    ):
+        with pytest.raises(CurlRejected):
+            parse_curl(cmd, "http://localhost:32768")
+
+
+def test_forbidden_curl_options_rejected():
+    for opt in ("-o /tmp/x", "--output /tmp/x", "-K cfg", "--netrc",
+                "-u user:pass", "--trace log", "-F a=@/etc/passwd",
+                "-T /etc/passwd"):
+        with pytest.raises(CurlRejected):
+            parse_curl(f"curl {opt} http://localhost:32768/v1/models",
+                       "http://localhost:32768")
+    with pytest.raises(CurlRejected):  # body-from-file
+        parse_curl("curl -d @/etc/passwd http://localhost:32768/x",
+                   "http://localhost:32768")
+
+
+def test_host_whitelist():
+    router = "http://localhost:32768"
+    # router host + localhost aliases OK
+    parse_curl("curl http://localhost:32768/v1/models", router)
+    parse_curl("curl http://127.0.0.1:32768/v1/models", router)
+    # bare path resolves against the router
+    spec = parse_curl("curl /v1/models", router)
+    assert spec["url"] == "http://localhost:32768/v1/models"
+    # foreign host / wrong port / bad scheme refused
+    for url in ("http://evil.example/v1/models",
+                "http://localhost:9999/v1/models",
+                "ftp://localhost:32768/x"):
+        with pytest.raises(CurlRejected):
+            parse_curl(f"curl {url}", router)
+
+
+def test_parse_methods_headers_data():
+    spec = parse_curl(
+        'curl -X PUT -H "X-Thing: 1" -d \'{"a":1}\' /api/endpoints/xyz',
+        "http://localhost:32768",
+    )
+    assert spec["method"] == "PUT"
+    assert spec["headers"]["X-Thing"] == "1"
+    assert json.loads(spec["data"]) == {"a": 1}
+    # data implies POST when no -X
+    spec = parse_curl("curl -d '{}' /x", "http://localhost:32768")
+    assert spec["method"] == "POST"
+
+
+def test_mask_sensitive():
+    masked = mask_sensitive(
+        'curl -H "Authorization: Bearer sk_abc123" -H "x-api-key: sk_zzz" /x'
+    )
+    assert "sk_abc123" not in masked and "sk_zzz" not in masked
+    assert "Bearer ***" in masked
+
+
+def test_openapi_lists_core_paths():
+    paths = openapi_summary()["paths"]
+    assert "/v1/chat/completions" in paths
+    assert "/api/endpoints" in paths
+
+
+def test_run_curl_against_live_gateway_with_auto_auth():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            key = await gw.inference_key()
+            base = f"http://127.0.0.1:{gw.client.port}"
+            import functools
+
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(None, functools.partial(
+                run_curl, f"curl {base}/v1/models",
+                router_url=base, api_key=key,
+            ))
+            assert result["status"] == 200, result
+            assert "data" in json.loads(result["body"])
+            # echoed command never contains the key
+            assert key not in result["executed_command"]
+        finally:
+            await gw.close()
+
+    asyncio.run(run())

@@ -118,7 +118,6 @@ def test_client_abort_mid_stream_releases_lease():
     async def run():
         gw = await GatewayHarness.create()
         hang = await HangingStreamEndpoint(model="m").start()
-        fast = await MockOpenAIEndpoint(model="m").start()
         try:
             ep = gw.register_mock(hang.url, ["m"], name="hang")
             _tune_queue(gw, max_active_per_endpoint=1)
@@ -143,7 +142,6 @@ def test_client_abort_mid_stream_releases_lease():
             assert gw.state.load_manager.active_count(ep.id) == 0
         finally:
             await hang.stop()
-            await fast.stop()
             await gw.close()
 
     asyncio.run(run())

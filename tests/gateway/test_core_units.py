@@ -148,3 +148,52 @@ def test_registry_capability_listing(tmp_path):
     ])
     assert len(reg.list_online_by_capability(Capability.AUDIO_TRANSCRIPTION)) == 1
     assert reg.list_online_by_capability(Capability.IMAGE_GENERATION) == []
+
+
+def test_engine_tag_parsing():
+    from llmlb_tpu.gateway.model_names import parse_engine_tag
+
+    p = parse_engine_tag("llama3.1:8b-instruct-q4_K_M")
+    assert p["family"] == "llama3.1"
+    assert p["size"] == "8b"
+    assert p["variant"] == "instruct"
+    assert p["quant"] == "q4_k_m"
+
+    p = parse_engine_tag("Meta-Llama-3-8B-Instruct.Q5_K_S.gguf")
+    assert p["quant"] == "q5_k_s"
+
+    p = parse_engine_tag("mistral:7b")
+    assert p["size"] == "7b" and p["variant"] is None
+
+
+def test_hf_repo_guessing():
+    from llmlb_tpu.gateway.model_names import guess_hf_repo
+
+    # table hits resolve exactly
+    assert guess_hf_repo("llama3:8b") == "meta-llama/Meta-Llama-3-8B-Instruct"
+    assert guess_hf_repo("mixtral:8x7b") == (
+        "mistralai/Mixtral-8x7B-Instruct-v0.1"
+    )
+    # unknown names fall to family->org heuristics
+    assert guess_hf_repo("qwen3:32b").startswith("Qwen/")
+    assert guess_hf_repo("gemma3:4b").startswith("google/")
+    assert guess_hf_repo("total-mystery-model") is None
+
+
+def test_quant_alias_resolution():
+    from llmlb_tpu.gateway.model_names import to_canonical
+
+    assert to_canonical("llama3:8b") == "meta-llama/Meta-Llama-3-8B-Instruct"
+    assert to_canonical("tinyllama:1.1b") == "TinyLlama/TinyLlama-1.1B-Chat-v1.0"
+    assert to_canonical("bge-m3") == "BAAI/bge-m3"
+
+
+def test_context_length_extraction():
+    from llmlb_tpu.gateway.engine_metadata import _context_length_from
+
+    assert _context_length_from(
+        {"model_info": {"llama.context_length": 8192}}) == 8192
+    assert _context_length_from({"max_context_length": "4096"}) == 4096
+    assert _context_length_from({"details": {"num_ctx": 2048}}) == 2048
+    assert _context_length_from({"nothing": 1}) is None
+    assert _context_length_from({"context_length": -5}) is None

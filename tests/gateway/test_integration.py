@@ -239,3 +239,31 @@ def test_endpoint_registration_via_api_with_detection_and_sync():
             await mock.stop()
             await gw.close()
     asyncio.run(run())
+
+
+def test_ollama_sync_enriches_context_length():
+    """Per-engine metadata (reference metadata/ollama.rs): models synced from
+    an Ollama endpoint get their context length from /api/show."""
+
+    async def run():
+        gw = await GatewayHarness.create()
+        ollama = await MockOllamaEndpoint(models=("llama3:8b",)).start()
+        try:
+            from llmlb_tpu.gateway.model_sync import sync_endpoint_models
+            from llmlb_tpu.gateway.types import Endpoint, EndpointStatus
+
+            ep = Endpoint(name="o", base_url=ollama.url,
+                          endpoint_type=EndpointType.OLLAMA,
+                          status=EndpointStatus.ONLINE)
+            gw.state.registry.add(ep)
+            await sync_endpoint_models(ep, gw.state.registry, gw.state.http)
+            models = gw.state.registry.models_for(ep.id)
+            assert models[0].context_length == 8192
+            assert models[0].canonical_name == (
+                "meta-llama/Meta-Llama-3-8B-Instruct"
+            )
+        finally:
+            await ollama.stop()
+            await gw.close()
+
+    asyncio.run(run())

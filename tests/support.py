@@ -155,9 +155,19 @@ class MockOllamaEndpoint:
         app = web.Application()
         app.router.add_get("/api/tags", self._tags)
         app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/api/show", self._show)
         self.server = TestServer(app)
         await self.server.start_server()
         return self
+
+    async def _show(self, request):
+        body = await request.json()
+        if body.get("name") not in self.models:
+            return web.json_response({"error": "model not found"}, status=404)
+        return web.json_response({
+            "details": {"family": "llama"},
+            "model_info": {"llama.context_length": 8192},
+        })
 
     async def stop(self) -> None:
         if self.server:

@@ -1,0 +1,92 @@
+"""Model-ingestion probe (SURVEY §2.3 #2-3 TPU equivalent): checkpoint
+validation + StableHLO lowering proof."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from llmlb_tpu.tools.ingest_probe import main, probe_checkpoint
+
+
+def _write_safetensors(path, tensors: dict):
+    header = {}
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        data = arr.tobytes()
+        dtype = {"float32": "F32", "float16": "F16", "int32": "I32"}[
+            str(arr.dtype)]
+        header[name] = {"dtype": dtype, "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + len(data)]}
+        blobs.append(data)
+        offset += len(data)
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for b in blobs:
+            f.write(b)
+
+
+@pytest.fixture
+def good_ckpt(tmp_path):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    _write_safetensors(str(d / "model.safetensors"), {
+        "model.embed_tokens.weight": np.ones((16, 8), np.float32),
+        "lm_head.weight": np.ones((16, 8), np.float32),
+    })
+    (d / "config.json").write_text(json.dumps({
+        "vocab_size": 16, "hidden_size": 8, "intermediate_size": 16,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "max_position_embeddings": 64,
+        "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+    }))
+    return d
+
+
+def test_probe_reports_clean_checkpoint(good_ckpt):
+    report = probe_checkpoint(str(good_ckpt))
+    assert report.tensor_count == 2
+    assert report.total_bytes > 0
+    assert report.config["num_layers"] == 2
+    # layer cross-check only fires when shards carry model.layers.*; a clean
+    # header-level pass has no findings
+    assert report.ok, report.findings
+
+
+def test_probe_flags_nan_and_missing_index(tmp_path):
+    d = tmp_path / "bad"
+    d.mkdir()
+    arr = np.ones((8, 8), np.float32)
+    arr[3, 3] = np.nan
+    _write_safetensors(str(d / "model.safetensors"), {"w": arr})
+    (d / "model.safetensors.index.json").write_text(json.dumps({
+        "weight_map": {"w": "model.safetensors",
+                       "missing.weight": "model-00002.safetensors"}
+    }))
+    report = probe_checkpoint(str(d))
+    joined = " ".join(report.findings)
+    assert "non-finite" in joined or "NaN" in joined
+    assert "missing from" in joined
+    assert not report.ok
+
+
+def test_probe_empty_dir(tmp_path):
+    report = probe_checkpoint(str(tmp_path))
+    assert not report.ok
+    assert "no .safetensors" in report.findings[0]
+
+
+def test_probe_cli_and_stablehlo(good_ckpt, tmp_path, capsys):
+    out = tmp_path / "prefill.stablehlo"
+    rc = main([str(good_ckpt), "--stablehlo", str(out)])
+    printed = json.loads(capsys.readouterr().out)
+    assert rc == 0, printed
+    assert printed["ok"] is True
+    assert os.path.getsize(out) > 0
+    text = out.read_text()
+    assert "stablehlo" in text or "func.func" in text
