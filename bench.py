@@ -31,6 +31,7 @@ A100_CLASS_TOKS_PER_SEC = 3000.0
 # bf16 peak FLOPs by TPU generation (for the MFU estimate).
 _PEAK_FLOPS = {
     "v4": 275e12,
+    "v5lite": 197e12,  # device_kind "TPU v5 lite" == v5e
     "v5e": 197e12,
     "v5p": 459e12,
     "v6e": 918e12,

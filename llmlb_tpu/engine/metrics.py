@@ -25,6 +25,7 @@ class Histogram:
         self.counts = [0] * (len(buckets) + 1)  # last = +Inf
         self.total = 0.0
         self.n = 0
+        self.max = 0.0
 
     def observe(self, value: float) -> None:
         for i, edge in enumerate(self.edges):
@@ -35,9 +36,14 @@ class Histogram:
             self.counts[-1] += 1
         self.total += value
         self.n += 1
+        if value > self.max:
+            self.max = value
 
     def percentile(self, pct: float) -> float | None:
-        """Approximate percentile from bucket upper edges (None if empty)."""
+        """Approximate percentile from bucket upper edges (None if empty).
+        Percentiles above the top edge report the max observed value — a
+        finite, JSON-safe figure (`inf` would serialize as the non-standard
+        `Infinity` token and break strict parsers of /api/health)."""
         if self.n == 0:
             return None
         target = self.n * pct / 100.0
@@ -46,7 +52,7 @@ class Histogram:
             seen += self.counts[i]
             if seen >= target:
                 return edge
-        return float("inf")
+        return max(self.edges[-1], self.max)
 
 
 class EngineMetrics:
@@ -72,6 +78,14 @@ class EngineMetrics:
     def record_token(self, n: int = 1) -> None:
         with self._lock:
             self.tokens_total += n
+
+    def record_emit(self, itl_seconds: float | None) -> None:
+        """One locked update for the per-token hot path: a token plus its
+        inter-token latency (None for a slot's first emitted token)."""
+        with self._lock:
+            self.tokens_total += 1
+            if itl_seconds is not None:
+                self.itl.observe(itl_seconds)
 
     def record_request_done(self, finish: str) -> None:
         with self._lock:

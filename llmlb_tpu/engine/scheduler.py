@@ -357,6 +357,7 @@ class EngineCore:
         for idx, req in enumerate(candidates):
             if req.cancelled:
                 req.events.put(("done", "cancelled"))
+                self.metrics.record_request_done("cancelled")
                 continue
             n = len(req.prompt_ids)
             if n > budget:
@@ -473,6 +474,7 @@ class EngineCore:
             return False
         if self._is_cancelled(request):
             request.events.put(("done", "cancelled"))
+            self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
             return True
 
@@ -578,6 +580,7 @@ class EngineCore:
         if self._is_cancelled(request):
             request.finished_at = time.monotonic()
             request.events.put(("done", "cancelled"))
+            self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
             slot.request = None
             slot.prefilling = False
@@ -682,10 +685,10 @@ class EngineCore:
             return
         slot.generated += 1
         now = time.monotonic()
-        if slot.last_emit_at:
-            self.metrics.record_itl(now - slot.last_emit_at)
+        self.metrics.record_emit(
+            (now - slot.last_emit_at) if slot.last_emit_at else None
+        )
         slot.last_emit_at = now
-        self.metrics.record_token()
         with self._lock:
             self.total_tokens += 1
 

@@ -525,3 +525,27 @@ async def system_info(request: web.Request) -> web.Response:
             "in_flight": state.gate.in_flight,
         },
     })
+
+
+async def tray_status(request: web.Request) -> web.Response:
+    """Tray menu + notifications (headless backends expose them here since
+    there is no desktop shell to draw in — gui/tray.rs equivalent surface)."""
+    state = request.app["state"]
+    if state.tray is None:
+        return web.json_response({"enabled": False})
+    return web.json_response({"enabled": True, **state.tray.status()})
+
+
+async def tray_activate(request: web.Request) -> web.Response:
+    """Dispatch a tray menu click (the reference's tray→update-manager proxy,
+    reachable over HTTP because the backend is headless)."""
+    state = request.app["state"]
+    if state.tray is None:
+        return _json_error(404, "tray is not enabled (set LLMLB_TRAY=1)")
+    try:
+        body = await request.json()
+        item = str(body["item"])
+    except Exception:
+        return _json_error(400, "body must have 'item'")
+    result = await state.tray.activate(item)
+    return web.json_response(result, status=200 if result.get("ok") else 400)

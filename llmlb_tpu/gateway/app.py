@@ -389,6 +389,8 @@ def create_app(state: AppState) -> web.Application:
     r.add_get("/api/dashboard/settings", api_admin.get_settings)
     r.add_put("/api/dashboard/settings", api_admin.update_setting)
     r.add_get("/api/system", api_admin.system_info)
+    r.add_get("/api/system/tray", api_admin.tray_status)
+    r.add_post("/api/system/tray/activate", api_admin.tray_activate)
 
     # ---- dashboard data + WS
     r.add_get("/api/dashboard/overview", api_dashboard.overview)

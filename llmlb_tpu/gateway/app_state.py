@@ -53,6 +53,7 @@ class AppState:
     http: aiohttp.ClientSession
     health_checker: EndpointHealthChecker | None = None
     update_manager: object | None = None  # set by gateway.update
+    tray: object | None = None  # TrayController when LLMLB_TRAY=1
     started_at: float = dataclasses.field(default_factory=time.time)
     _tasks: list[asyncio.Task] = dataclasses.field(default_factory=list)
 

@@ -106,8 +106,10 @@ def parse_curl(command: str, router_url: str) -> dict:
                 raise CurlRejected("reading request bodies from files ('@') "
                                    "is not allowed")
             spec["data"] = body
-            if tok == "--json":
-                spec["headers"].setdefault("Content-Type", "application/json")
+            if tok == "--json" and not any(
+                h.lower() == "content-type" for h in spec["headers"]
+            ):
+                spec["headers"]["Content-Type"] = "application/json"
             i += 2
         elif tok in ("-m", "--max-time"):
             raw = arg_after(i, tok)
@@ -183,7 +185,11 @@ def run_curl(command: str, router_url: str | None = None,
         spec["headers"]["Authorization"] = f"Bearer {api_key}"
 
     data = spec["data"].encode() if spec["data"] is not None else None
-    if data is not None and "Content-Type" not in spec["headers"]:
+    # case-insensitive: urllib canonicalizes header names, so a check on the
+    # exact spelling would clobber a user-supplied 'content-type: …'
+    if data is not None and not any(
+        h.lower() == "content-type" for h in spec["headers"]
+    ):
         spec["headers"]["Content-Type"] = "application/json"
     req = urllib.request.Request(
         spec["url"], data=data, method=spec["method"],

@@ -67,21 +67,36 @@ async def fetch_context_length(
                 body = await resp.json(content_type=None)
             return _context_length_from(body if isinstance(body, dict) else {})
         if ep.endpoint_type == EndpointType.LM_STUDIO:
-            async with session.get(
-                ep.url + "/api/v1/models", headers=headers,
-                timeout=aiohttp.ClientTimeout(total=timeout),
-            ) as resp:
-                if resp.status != 200:
-                    return None
-                body = await resp.json(content_type=None)
-            entries = body.get("data") if isinstance(body, dict) else None
-            for entry in entries or []:
-                if isinstance(entry, dict) and entry.get("id") == model_id:
-                    return _context_length_from(entry)
-            return None
+            listing = await _lm_studio_listing(ep, session, timeout)
+            return _context_length_from(listing.get(model_id, {}))
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError, ValueError):
         return None
     return None
+
+
+async def _lm_studio_listing(
+    ep: Endpoint, session: aiohttp.ClientSession, timeout: float = 5.0
+) -> dict[str, dict]:
+    """One fetch of LM Studio's /api/v1/models, indexed by model id — the
+    listing carries every model's metadata, so per-model fetches are waste."""
+    headers = {}
+    if ep.api_key:
+        headers["Authorization"] = f"Bearer {ep.api_key}"
+    try:
+        async with session.get(
+            ep.url + "/api/v1/models", headers=headers,
+            timeout=aiohttp.ClientTimeout(total=timeout),
+        ) as resp:
+            if resp.status != 200:
+                return {}
+            body = await resp.json(content_type=None)
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError, ValueError):
+        return {}
+    entries = body.get("data") if isinstance(body, dict) else None
+    return {
+        e["id"]: e for e in entries or []
+        if isinstance(e, dict) and "id" in e
+    }
 
 
 async def enrich_context_lengths(
@@ -96,6 +111,13 @@ async def enrich_context_lengths(
     if not targets or ep.endpoint_type not in (
         EndpointType.OLLAMA, EndpointType.LM_STUDIO
     ):
+        return
+    if ep.endpoint_type == EndpointType.LM_STUDIO:
+        listing = await _lm_studio_listing(ep, session)
+        for m in targets:
+            m.context_length = _context_length_from(
+                listing.get(m.model_id, {})
+            )
         return
     sem = asyncio.Semaphore(concurrency)
 

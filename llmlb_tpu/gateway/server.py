@@ -63,6 +63,19 @@ async def run_server(config: ServerConfig | None = None) -> None:
     elif ":" in probe_host:  # bare IPv6 address needs brackets in a URL
         probe_host = f"[{probe_host}]"
 
+    # Tray equivalent (reference gui/tray.rs, win/mac only): opt-in on these
+    # headless TPU hosts; menu/notifications surface at /api/system/tray.
+    if os.environ.get("LLMLB_TRAY", "0").lower() in ("1", "true"):
+        from llmlb_tpu.gateway.tray import TrayController
+
+        state.tray = TrayController(
+            f"http://{probe_host}:{config.port}/dashboard",
+            state.update_manager,
+            events=state.events,
+            quit_cb=stop_event.set,
+        )
+        await state.tray.start()
+
     async def self_health() -> bool:
         try:
             async with state.http.get(
@@ -96,6 +109,8 @@ async def run_server(config: ServerConfig | None = None) -> None:
     finally:
         log.info("shutting down")
         watch_task.cancel()
+        if state.tray is not None:
+            await state.tray.stop()
         await state.update_manager.stop_background_tasks()
         # Drain in-flight inference before tearing the server down: with the
         # 5 s shutdown grace above, an ordinary SIGTERM would otherwise cut

@@ -24,7 +24,6 @@ import dataclasses
 import glob
 import json
 import os
-import sys
 
 import numpy as np
 
@@ -54,7 +53,11 @@ _SUPPORTED_DTYPES = {"F32", "F16", "BF16", "I32", "I64", "U8", "I8"}
 def _iter_shard_tensors(path: str):
     """Yield (name, dtype_str, shape, np_array_or_None) per tensor. Uses the
     native mmap reader when built; otherwise parses the safetensors header
-    in Python (header-only: no data validation on the fallback path)."""
+    in Python (header-only: no data validation on the fallback path). The
+    fallback only engages when the native reader failed before yielding
+    anything — a mid-iteration native failure must propagate rather than
+    restart the walk and double-count tensors already yielded."""
+    yielded = False
     try:
         from llmlb_tpu.native import NativeSafetensors
 
@@ -62,12 +65,14 @@ def _iter_shard_tensors(path: str):
         try:
             for name in st.keys():
                 arr = st.get_tensor(name)
+                yielded = True
                 yield name, str(arr.dtype), tuple(arr.shape), arr
         finally:
             st.close()
         return
     except Exception:
-        pass
+        if yielded:
+            raise
     # pure-python header walk
     import struct
 
@@ -104,10 +109,14 @@ def probe_checkpoint(model_dir: str, *, sample_values: bool = True,
                 seen[name] = (os.path.basename(path), shape)
                 if arr is None:  # header-only path: safetensors dtype string
                     bad_dtype = dtype.upper() not in _SUPPORTED_DTYPES
-                else:  # native path: numpy dtype string
+                else:
+                    # native path: numpy dtype string. bfloat16 comes from
+                    # ml_dtypes, for which np.issubdtype(.., np.number) is
+                    # False — but it is the dominant LLM checkpoint dtype.
                     try:
-                        bad_dtype = not np.issubdtype(
-                            np.dtype(dtype), np.number
+                        bad_dtype = not (
+                            str(dtype) == "bfloat16"
+                            or np.issubdtype(np.dtype(dtype), np.number)
                         )
                     except TypeError:
                         bad_dtype = True
@@ -118,11 +127,14 @@ def probe_checkpoint(model_dir: str, *, sample_values: bool = True,
                 if arr is not None and sample_values and arr.size:
                     flat = arr.reshape(-1)
                     # bounded sample: checking multi-GB tensors fully would
-                    # defeat the point of an mmap probe
+                    # defeat the point of an mmap probe. bfloat16 counts as
+                    # floating even though np.issubdtype says otherwise.
+                    is_float = (str(arr.dtype) == "bfloat16"
+                                or np.issubdtype(arr.dtype, np.floating))
                     sample = np.asarray(
                         flat[:: max(1, flat.size // 4096)][:8192],
                         np.float32,
-                    ) if np.issubdtype(arr.dtype, np.floating) else None
+                    ) if is_float else None
                     if sample is not None and not np.isfinite(sample).all():
                         report.findings.append(
                             f"{name}: non-finite values (NaN/Inf) in shard "
@@ -208,18 +220,22 @@ def _emit_stablehlo(cfg, out_path: str) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if not argv or argv[0] in ("-h", "--help"):
-        print(__doc__)
-        return 0
-    model_dir = argv[0]
-    stablehlo = None
-    if "--stablehlo" in argv:
-        stablehlo = argv[argv.index("--stablehlo") + 1]
-    if not os.path.isdir(model_dir):
-        print(json.dumps({"error": f"not a directory: {model_dir}"}))
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m llmlb_tpu.tools.ingest_probe",
+        description="Validate a checkpoint before serving it.",
+    )
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument(
+        "--stablehlo", metavar="OUT",
+        help="also lower the prefill step to StableHLO text at OUT",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.checkpoint_dir):
+        print(json.dumps({"error": f"not a directory: {args.checkpoint_dir}"}))
         return 2
-    report = probe_checkpoint(model_dir, stablehlo_out=stablehlo)
+    report = probe_checkpoint(args.checkpoint_dir, stablehlo_out=args.stablehlo)
     print(json.dumps(report.to_json(), indent=2))
     return 0 if report.ok else 1
 

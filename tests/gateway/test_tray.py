@@ -1,0 +1,189 @@
+"""Tray controller: menu model follows update state, notifications fire on
+available/failed, menu activation proxies into the UpdateManager.
+
+Parity target: reference gui/tray.rs:37-135 (tray menu composition + event
+proxy into the update manager). Our backend is headless; the controller logic
+is the same surface a GUI backend would drive.
+"""
+
+import asyncio
+
+import pytest
+
+from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.gate import InferenceGate
+from llmlb_tpu.gateway.tray import HeadlessTrayBackend, TrayController
+from llmlb_tpu.gateway.update import UpdateManager, UpdateState
+
+
+def _menu_ids(tray):
+    return [i["id"] for i in tray.backend.menu]
+
+
+def _item(tray, item_id):
+    return next(i for i in tray.backend.menu if i["id"] == item_id)
+
+
+@pytest.fixture
+def update_manager():
+    return UpdateManager(InferenceGate(), events=DashboardEventBus())
+
+
+def test_menu_model_baseline(update_manager):
+    tray = TrayController("http://x/dashboard", update_manager)
+    assert _menu_ids(tray) == ["open_dashboard", "update", "schedule", "quit"]
+    assert _item(tray, "update")["label"] == "Check for updates"
+    assert _item(tray, "schedule")["label"] == "Update schedule: immediate"
+    assert _item(tray, "schedule")["enabled"] is False
+
+
+def test_menu_follows_update_state(update_manager):
+    tray = TrayController("http://x/dashboard", update_manager)
+    update_manager.available_version = "v2.0.0"
+    update_manager.state = UpdateState.AVAILABLE
+    tray.refresh()
+    assert "v2.0.0" in _item(tray, "update")["label"]
+    assert _item(tray, "update")["enabled"] is True
+
+    update_manager.state = UpdateState.DRAINING
+    tray.refresh()
+    assert "draining" in _item(tray, "update")["label"].lower()
+    assert _item(tray, "update")["enabled"] is False
+
+    update_manager.state = UpdateState.FAILED
+    update_manager.error = "disk full"
+    tray.refresh()
+    assert "disk full" in _item(tray, "update")["label"]
+
+
+def test_schedule_display(update_manager):
+    tray = TrayController("http://x/dashboard", update_manager)
+    update_manager.set_schedule("on_idle")
+    tray.refresh()
+    assert _item(tray, "schedule")["label"] == "Update schedule: when idle"
+
+
+@pytest.mark.asyncio
+async def test_activate_check_and_apply(update_manager):
+    checks = []
+
+    async def check_hook():
+        checks.append(1)
+        return {"version": "v3.0.0"}
+
+    update_manager.check_hook = check_hook
+    applied = asyncio.Event()
+
+    async def apply_hook():
+        applied.set()
+
+    update_manager.apply_hook = apply_hook
+    tray = TrayController("http://x/dashboard", update_manager)
+
+    # no update known yet → activation runs a forced check
+    result = await tray.activate("update")
+    assert result["action"] == "check" and checks
+    assert update_manager.state == UpdateState.AVAILABLE
+
+    # update now available → activation requests the apply
+    result = await tray.activate("update")
+    assert result["action"] == "apply" and result["ok"]
+    await asyncio.wait_for(applied.wait(), 5)
+
+
+@pytest.mark.asyncio
+async def test_open_dashboard_and_quit(update_manager):
+    opened, quit_called = [], []
+    tray = TrayController(
+        "http://gw:1234/dashboard", update_manager,
+        open_url_cb=opened.append, quit_cb=lambda: quit_called.append(1),
+    )
+    assert (await tray.activate("open_dashboard"))["ok"]
+    assert opened == ["http://gw:1234/dashboard"]
+    assert (await tray.activate("quit"))["ok"] and quit_called
+    assert not (await tray.activate("nonsense"))["ok"]
+
+
+@pytest.mark.asyncio
+async def test_event_bus_notification(update_manager):
+    """UpdateStateChanged(available) on the bus → one tray notification and a
+    refreshed menu; repeated events for the same version don't re-notify."""
+    events = update_manager.events
+    tray = TrayController(
+        "http://x/dashboard", update_manager, events=events,
+        backend=HeadlessTrayBackend(),
+    )
+    await tray.start()
+    try:
+        update_manager.available_version = "v5.0.0"
+        update_manager.state = UpdateState.AVAILABLE
+        events.publish(
+            "UpdateStateChanged", {"state": "available", "version": "v5.0.0"}
+        )
+        for _ in range(100):
+            if tray.backend.notifications:
+                break
+            await asyncio.sleep(0.01)
+        assert len(tray.backend.notifications) == 1
+        assert "v5.0.0" in tray.backend.notifications[0]["body"]
+        assert "v5.0.0" in _item(tray, "update")["label"]
+
+        events.publish(
+            "UpdateStateChanged", {"state": "available", "version": "v5.0.0"}
+        )
+        await asyncio.sleep(0.05)
+        assert len(tray.backend.notifications) == 1  # deduped
+    finally:
+        await tray.stop()
+
+
+@pytest.mark.asyncio
+async def test_tray_http_surface():
+    """/api/system/tray reports disabled without a controller, and serves the
+    menu + activation proxy once one is attached (headless tray's 'display')."""
+    from tests.support import GatewayHarness
+
+    gw = await GatewayHarness.create()
+    try:
+        headers = await gw.admin_headers()
+        resp = await gw.client.get("/api/system/tray", headers=headers)
+        assert resp.status == 200
+        assert (await resp.json()) == {"enabled": False}
+
+        resp = await gw.client.post(
+            "/api/system/tray/activate", json={"item": "update"},
+            headers=headers,
+        )
+        assert resp.status == 404
+
+        update = UpdateManager(gw.state.gate, events=gw.state.events)
+
+        async def check_hook():
+            return {"version": "v7.7.7"}
+
+        update.check_hook = check_hook
+        gw.state.tray = TrayController("http://x/dashboard", update)
+
+        resp = await gw.client.get("/api/system/tray", headers=headers)
+        body = await resp.json()
+        assert body["enabled"] is True
+        assert [i["id"] for i in body["menu"]] == [
+            "open_dashboard", "update", "schedule", "quit",
+        ]
+
+        resp = await gw.client.post(
+            "/api/system/tray/activate", json={"item": "update"},
+            headers=headers,
+        )
+        assert resp.status == 200
+        assert (await resp.json())["action"] == "check"
+        assert update.state == UpdateState.AVAILABLE
+
+        # bad credentials are refused like the rest of /api/* (a bare GET
+        # would ride the admin session cookie the login above set)
+        resp = await gw.client.get(
+            "/api/system/tray", headers={"Authorization": "Bearer bogus"}
+        )
+        assert resp.status == 401
+    finally:
+        await gw.close()
