@@ -163,30 +163,37 @@ class TrayController:
     async def _pump(self, queue: asyncio.Queue) -> None:
         while True:
             event = await queue.get()
-            if event.get("type") != "UpdateStateChanged":
-                continue
-            data = event.get("data") or {}
-            state, version = data.get("state"), data.get("version")
-            if (state == "available" and version
-                    and version != self._notified_version):
-                self._notified_version = version
-                self.backend.notify(
-                    "Update available",
-                    f"Version {version} is ready to apply from the tray menu.",
-                )
-            elif state == "failed":
-                self.backend.notify(
-                    "Update failed",
-                    str(self.update.status().get("error") or "see logs"),
-                )
-            self.refresh()
+            try:
+                if event.get("type") != "UpdateStateChanged":
+                    continue
+                data = event.get("data") or {}
+                state, version = data.get("state"), data.get("version")
+                if (state == "available" and version
+                        and version != self._notified_version):
+                    self._notified_version = version
+                    self.backend.notify(
+                        "Update available",
+                        f"Version {version} is ready to apply from the tray "
+                        "menu.",
+                    )
+                elif state == "failed":
+                    self.backend.notify(
+                        "Update failed",
+                        str(self.update.status().get("error") or "see logs"),
+                    )
+                self.refresh()
+            except Exception:
+                # one bad event must not kill tray notifications for good
+                log.exception("tray event handling failed; continuing")
 
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
             try:
                 await self._task
-            except asyncio.CancelledError:
+            except (asyncio.CancelledError, Exception):
+                # a pump that died earlier must not abort the server's
+                # shutdown sequence (drain + update-manager stop follow us)
                 pass
             self._task = None
         if self.events is not None and self._sub_id is not None:

@@ -1,0 +1,158 @@
+"""Burst decode (k steps per dispatch) must match single-step decode.
+
+The scheduler's decode_burst fuses k decode+sample steps into one jitted
+lax.scan with on-device token feedback, syncing the host once per k tokens
+instead of per token (the host↔device round trip dominates each step through
+the axon tunnel: measured 93 ms RTT vs 3 ms compute). These tests pin the
+semantics the fusion must preserve: greedy outputs identical to the k=1 path,
+EOS/max_tokens finishing mid-burst trimmed, chunked prefill still interleaves.
+"""
+
+import asyncio
+
+import pytest
+
+from llmlb_tpu.engine.presets import get_preset
+from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+
+
+def _collect(req: Request, timeout: float = 60.0) -> tuple[list[int], str]:
+    tokens: list[int] = []
+    while True:
+        kind, val = req.events.get(timeout=timeout)
+        if kind == "token":
+            tokens.append(val)
+        elif kind == "done":
+            return tokens, val
+        elif kind == "error":
+            raise RuntimeError(val)
+
+
+def _run_greedy(core: EngineCore, prompts: list[list[int]],
+                max_tokens: int = 12) -> list[tuple[list[int], str]]:
+    reqs = [
+        Request(prompt_ids=p,
+                sampling=SamplingParams(temperature=0.0, max_tokens=max_tokens))
+        for p in prompts
+    ]
+    for r in reqs:
+        core.submit(r)
+    return [_collect(r) for r in reqs]
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return get_preset("debug-tiny")
+
+
+def test_burst_matches_single_step_greedy(cfg):
+    """Token-for-token equivalence: burst=4 vs burst=1 on the same prompts."""
+    prompts = [[5, 9, 2], [7, 7, 7, 7], [3]]
+    core1 = EngineCore(cfg, num_slots=4, slot_capacity=64,
+                       prefill_buckets=(16, 32), seed=0, decode_burst=1)
+    core1.start()
+    try:
+        base = _run_greedy(core1, prompts)
+    finally:
+        core1.stop()
+
+    core4 = EngineCore(cfg, num_slots=4, slot_capacity=64,
+                       prefill_buckets=(16, 32), seed=0, decode_burst=4)
+    core4.start()
+    try:
+        burst = _run_greedy(core4, prompts)
+    finally:
+        core4.stop()
+
+    assert burst == base
+
+
+def test_burst_trims_max_tokens_mid_burst(cfg):
+    """max_tokens that is not a multiple of the burst still stops exactly."""
+    core = EngineCore(cfg, num_slots=2, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0, decode_burst=8)
+    core.start()
+    try:
+        req = Request(prompt_ids=[1, 2, 3],
+                      sampling=SamplingParams(temperature=0.0, max_tokens=5))
+        core.submit(req)
+        tokens, finish = _collect(req)
+        # first token comes from prefill; 5 generated total, EOS never hit
+        # with random weights on a 64-vocab byte model is unlikely but legal
+        assert finish in ("stop", "length")
+        assert len(tokens) <= 5
+        if finish == "length":
+            assert len(tokens) == 5
+    finally:
+        core.stop()
+
+
+def test_burst_respects_slot_capacity(cfg):
+    """A request whose room runs out mid-burst finishes with 'length' and
+    never reports more tokens than the slot can hold."""
+    core = EngineCore(cfg, num_slots=2, slot_capacity=24,
+                      prefill_buckets=(16,), seed=0, decode_burst=8)
+    core.start()
+    try:
+        prompt = [4] * 10
+        req = Request(prompt_ids=prompt,
+                      sampling=SamplingParams(temperature=0.0, max_tokens=500))
+        core.submit(req)
+        tokens, finish = _collect(req)
+        assert finish in ("stop", "length")
+        # every generated token's KV lands after the prompt's; the sequence
+        # must stay within the 24-cell slot row
+        assert 10 + len(tokens) <= 24
+    finally:
+        core.stop()
+
+
+def test_burst_with_chunked_prefill_interleaves(cfg):
+    """A long prompt (chunked prefill) and a short decode share the loop with
+    burst decode on: both finish, the short one keeps emitting during the
+    long one's prefill."""
+    core = EngineCore(cfg, num_slots=2, slot_capacity=128,
+                      prefill_buckets=(16, 32), seed=0, decode_burst=4)
+    core.start()
+    try:
+        short = Request(prompt_ids=[8, 8],
+                        sampling=SamplingParams(temperature=0.0, max_tokens=20))
+        long = Request(prompt_ids=list(range(1, 100)),
+                       sampling=SamplingParams(temperature=0.0, max_tokens=4))
+        core.submit(short)
+        core.submit(long)
+        s_tokens, s_finish = _collect(short)
+        l_tokens, l_finish = _collect(long)
+        assert s_finish in ("stop", "length")
+        assert l_finish in ("stop", "length")
+    finally:
+        core.stop()
+
+
+def test_burst_cancellation_mid_stream(cfg):
+    """Cancel during generation: the slot frees and the request ends with
+    'cancelled' even when cancellation lands mid-burst."""
+    core = EngineCore(cfg, num_slots=2, slot_capacity=128,
+                      prefill_buckets=(16,), seed=0, decode_burst=4)
+    core.start()
+    try:
+        req = Request(prompt_ids=[9, 9, 9],
+                      sampling=SamplingParams(temperature=0.0, max_tokens=100))
+        core.submit(req)
+        # wait for the first token, then cancel
+        kind, _ = req.events.get(timeout=60)
+        assert kind == "token"
+        req.cancel()
+        while True:
+            kind, val = req.events.get(timeout=60)
+            if kind == "done":
+                assert val == "cancelled"
+                break
+        # slot must be reusable afterwards
+        nxt = Request(prompt_ids=[2, 2],
+                      sampling=SamplingParams(temperature=0.0, max_tokens=3))
+        core.submit(nxt)
+        _, finish = _collect(nxt)
+        assert finish in ("stop", "length")
+    finally:
+        core.stop()
