@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import threading
 import time
 import typing
@@ -140,7 +141,8 @@ class RequestLease:
 
 
 class LoadManager:
-    def __init__(self, queue_config: QueueConfig | None = None):
+    def __init__(self, queue_config: QueueConfig | None = None,
+                 use_native: bool | None = None):
         self.queue_config = queue_config or QueueConfig()
         self._lock = threading.Lock()
         # (endpoint_id, model, api_kind) -> ModelTpsState
@@ -153,6 +155,22 @@ class LoadManager:
         # released — the AdmissionQueue uses it to wake parked waiters instead
         # of having them poll (parity: balancer/mod.rs:2273-2427 notify path).
         self.on_release: typing.Callable[[str], None] | None = None
+        # Native scheduler core (native/router_core.cpp): the same state
+        # machine in C++, selection-for-selection identical to the Python
+        # path below (tested side by side). Python remains the fallback and
+        # the behavioral reference. LLMLB_NATIVE_ROUTER=0 disables.
+        self._rc = None
+        if use_native is None:
+            use_native = os.environ.get(
+                "LLMLB_NATIVE_ROUTER", "1"
+            ).lower() not in ("0", "false")
+        if use_native:
+            try:
+                from llmlb_tpu.native import NativeRouterCore
+
+                self._rc = NativeRouterCore(TPS_EMA_ALPHA)
+            except (RuntimeError, OSError):
+                self._rc = None
 
     # ------------------------------------------------------------------- TPS
 
@@ -160,6 +178,12 @@ class LoadManager:
         self, endpoint_id: str, model: str, api_kind: TpsApiKind,
         tokens: int, duration_s: float,
     ) -> None:
+        if self._rc is not None:
+            self._rc.update_tps(endpoint_id, model, api_kind.value,
+                                tokens, duration_s, time.time())
+            return
+        if duration_s <= 0 or tokens <= 0:
+            return  # rejected samples must not create phantom tracked keys
         with self._lock:
             key = (endpoint_id, model, api_kind.value)
             state = self._tps.setdefault(key, ModelTpsState())
@@ -168,6 +192,10 @@ class LoadManager:
     def seed_tps(self, endpoint_id: str, model: str, api_kind: TpsApiKind,
                  ema_tps: float, samples: int = 1) -> None:
         """Warm-start from persisted daily stats at boot (bootstrap parity)."""
+        if self._rc is not None:
+            self._rc.seed_tps(endpoint_id, model, api_kind.value,
+                              ema_tps, samples, time.time())
+            return
         with self._lock:
             self._tps[(endpoint_id, model, api_kind.value)] = ModelTpsState(
                 ema_tps=ema_tps, samples=samples, last_update=time.time()
@@ -175,18 +203,25 @@ class LoadManager:
 
     def get_tps(self, endpoint_id: str, model: str,
                 api_kind: TpsApiKind) -> float | None:
+        if self._rc is not None:
+            return self._rc.get_tps(endpoint_id, model, api_kind.value)
         with self._lock:
             state = self._tps.get((endpoint_id, model, api_kind.value))
             return state.ema_tps if state and state.samples else None
 
     def clear_tps_for_endpoint(self, endpoint_id: str) -> None:
         """On failure: a recovered endpoint must re-learn (balancer/mod.rs:1791)."""
+        if self._rc is not None:
+            self._rc.clear_endpoint(endpoint_id)
+            return
         with self._lock:
             self._tps = {
                 k: v for k, v in self._tps.items() if k[0] != endpoint_id
             }
 
     def tps_snapshot(self) -> dict[str, dict]:
+        if self._rc is not None:
+            return self._rc.snapshot()
         with self._lock:
             return {
                 f"{eid}:{model}:{kind}": {
@@ -210,8 +245,22 @@ class LoadManager:
         full endpoints (admission cap) excluded."""
         if not endpoints:
             return None
+        if self._rc is not None:
+            idx = self._rc_select(endpoints, model, api_kind, admit=False)
+            return None if idx < 0 else endpoints[idx]
         with self._lock:
             return self._select_locked(endpoints, model, api_kind)
+
+    def _rc_select(self, endpoints: list[Endpoint], model: str,
+                   api_kind: TpsApiKind, *, admit: bool) -> int:
+        now = time.time()
+        return self._rc.select(
+            model, api_kind.value,
+            [ep.id for ep in endpoints],
+            [telemetry_penalty(ep, now) for ep in endpoints],
+            self.queue_config.max_active_per_endpoint,
+            admit,
+        )
 
     def _select_locked(
         self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind
@@ -253,6 +302,12 @@ class LoadManager:
         two-step had that race)."""
         if not endpoints:
             return None
+        if self._rc is not None:
+            idx = self._rc_select(endpoints, model, api_kind, admit=True)
+            if idx < 0:
+                return None
+            chosen = endpoints[idx]
+            return chosen, RequestLease(self, chosen.id, model, api_kind)
         with self._lock:
             chosen = self._select_locked(endpoints, model, api_kind)
             if chosen is None:
@@ -264,15 +319,21 @@ class LoadManager:
     def begin_request(
         self, endpoint: Endpoint, model: str, api_kind: TpsApiKind
     ) -> RequestLease:
+        if self._rc is not None:
+            self._rc.begin(endpoint.id)
+            return RequestLease(self, endpoint.id, model, api_kind)
         with self._lock:
             self._active[endpoint.id] += 1
             self._total_requests += 1
         return RequestLease(self, endpoint.id, model, api_kind)
 
     def _release_active(self, endpoint_id: str) -> None:
-        with self._lock:
-            if self._active[endpoint_id] > 0:
-                self._active[endpoint_id] -= 1
+        if self._rc is not None:
+            self._rc.release(endpoint_id)
+        else:
+            with self._lock:
+                if self._active[endpoint_id] > 0:
+                    self._active[endpoint_id] -= 1
         cb = self.on_release
         if cb is not None:
             try:
@@ -281,10 +342,14 @@ class LoadManager:
                 pass
 
     def active_count(self, endpoint_id: str) -> int:
+        if self._rc is not None:
+            return self._rc.active(endpoint_id)
         with self._lock:
             return self._active[endpoint_id]
 
     def total_active(self) -> int:
+        if self._rc is not None:
+            return self._rc.total_active()
         with self._lock:
             return sum(self._active.values())
 
@@ -316,6 +381,16 @@ class LoadManager:
             return [buckets[k] for k in sorted(buckets)]
 
     def stats(self) -> dict:
+        if self._rc is not None:
+            with self._lock:
+                history_size = len(self._history)
+            return {
+                "total_requests": self._rc.total_requests(),
+                "active_requests": self._rc.total_active(),
+                "history_size": history_size,
+                "tracked_tps_keys": self._rc.tracked_keys(),
+                "native_router": True,
+            }
         with self._lock:
             return {
                 "total_requests": self._total_requests,

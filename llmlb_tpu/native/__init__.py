@@ -54,6 +54,47 @@ def _configure(lib: ctypes.CDLL) -> None:
         c.c_char_p,
     ]
 
+    # Router core (scheduler hot path) — optional: older .so builds lack it,
+    # and LoadManager falls back to pure Python when these are absent.
+    if hasattr(lib, "rc_new"):
+        lib.rc_new.restype = c.c_void_p
+        lib.rc_new.argtypes = [c.c_double]
+        lib.rc_free.restype = None
+        lib.rc_free.argtypes = [c.c_void_p]
+        lib.rc_update_tps.restype = None
+        lib.rc_update_tps.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_char_p, c.c_char_p,
+            c.c_int64, c.c_double, c.c_double,
+        ]
+        lib.rc_seed_tps.restype = None
+        lib.rc_seed_tps.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_char_p, c.c_char_p,
+            c.c_double, c.c_int64, c.c_double,
+        ]
+        lib.rc_get_tps.restype = c.c_double
+        lib.rc_get_tps.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_char_p]
+        lib.rc_clear_endpoint.restype = None
+        lib.rc_clear_endpoint.argtypes = [c.c_void_p, c.c_char_p]
+        lib.rc_tracked_keys.restype = c.c_int64
+        lib.rc_tracked_keys.argtypes = [c.c_void_p]
+        lib.rc_begin.restype = None
+        lib.rc_begin.argtypes = [c.c_void_p, c.c_char_p]
+        lib.rc_release.restype = None
+        lib.rc_release.argtypes = [c.c_void_p, c.c_char_p]
+        lib.rc_active.restype = c.c_int64
+        lib.rc_active.argtypes = [c.c_void_p, c.c_char_p]
+        lib.rc_total_active.restype = c.c_int64
+        lib.rc_total_active.argtypes = [c.c_void_p]
+        lib.rc_total_requests.restype = c.c_int64
+        lib.rc_total_requests.argtypes = [c.c_void_p]
+        lib.rc_select.restype = c.c_int64
+        lib.rc_select.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_char_p),
+            c.POINTER(c.c_double), c.c_int64, c.c_int64, c.c_char_p, c.c_int,
+        ]
+        lib.rc_snapshot.restype = c.c_int64
+        lib.rc_snapshot.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+
     lib.sse_new.restype = c.c_void_p
     lib.sse_feed.restype = None
     lib.sse_feed.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
@@ -226,4 +267,101 @@ class NativeSseScanner:
     def __del__(self):
         if getattr(self, "_handle", None):
             self._lib.sse_free(self._handle)
+            self._handle = None
+
+
+# ---------------------------------------------------------------- router core
+
+
+class NativeRouterCore:
+    """C++ scheduler state: TPS-EMA map + active counts + round-robin
+    selection (native/router_core.cpp). Raises RuntimeError when the library
+    (or this symbol, in a stale build) is unavailable — LoadManager keeps the
+    pure-Python implementation as the fallback."""
+
+    def __init__(self, alpha: float):
+        lib = load_native()
+        if lib is None or not hasattr(lib, "rc_new"):
+            raise RuntimeError("native router core unavailable")
+        self._lib = lib
+        self._handle = lib.rc_new(alpha)
+
+    def update_tps(self, eid: str, model: str, kind: str,
+                   tokens: int, duration_s: float, now: float) -> None:
+        self._lib.rc_update_tps(
+            self._handle, eid.encode(), model.encode(), kind.encode(),
+            tokens, duration_s, now,
+        )
+
+    def seed_tps(self, eid: str, model: str, kind: str,
+                 ema: float, samples: int, now: float) -> None:
+        self._lib.rc_seed_tps(
+            self._handle, eid.encode(), model.encode(), kind.encode(),
+            ema, samples, now,
+        )
+
+    def get_tps(self, eid: str, model: str, kind: str) -> float | None:
+        v = self._lib.rc_get_tps(
+            self._handle, eid.encode(), model.encode(), kind.encode()
+        )
+        return None if v < 0 else v
+
+    def clear_endpoint(self, eid: str) -> None:
+        self._lib.rc_clear_endpoint(self._handle, eid.encode())
+
+    def tracked_keys(self) -> int:
+        return self._lib.rc_tracked_keys(self._handle)
+
+    def begin(self, eid: str) -> None:
+        self._lib.rc_begin(self._handle, eid.encode())
+
+    def release(self, eid: str) -> None:
+        self._lib.rc_release(self._handle, eid.encode())
+
+    def active(self, eid: str) -> int:
+        return self._lib.rc_active(self._handle, eid.encode())
+
+    def total_active(self) -> int:
+        return self._lib.rc_total_active(self._handle)
+
+    def total_requests(self) -> int:
+        return self._lib.rc_total_requests(self._handle)
+
+    def select(self, model: str, kind: str, eids: list[str],
+               penalties: list[float], cap: int, admit: bool) -> int:
+        n = len(eids)
+        arr = (ctypes.c_char_p * n)(*[e.encode() for e in eids])
+        pens = (ctypes.c_double * n)(*penalties)
+        return self._lib.rc_select(
+            self._handle, model.encode(), arr, pens, n, cap,
+            kind.encode(), 1 if admit else 0,
+        )
+
+    def snapshot(self) -> dict[str, dict]:
+        # Size-then-fill with a growth retry: the map can gain keys between
+        # the two calls (another thread's update_tps), in which case the fill
+        # call reports a larger size and we re-read — never parse a
+        # truncated buffer.
+        needed = self._lib.rc_snapshot(self._handle, None, 0)
+        while True:
+            if needed <= 0:
+                return {}
+            cap = needed + 4096  # slack for keys added between calls
+            buf = ctypes.create_string_buffer(cap)
+            needed = self._lib.rc_snapshot(self._handle, buf, cap)
+            if needed <= cap:
+                break
+        out: dict[str, dict] = {}
+        for line in buf.raw[:needed].decode().splitlines():
+            eid, model, kind, ema, samples, last_update = line.split("\t")
+            out[f"{eid}:{model}:{kind}"] = {
+                "ema_tps": round(float(ema), 3),
+                "samples": int(samples),
+                "last_update": float(last_update),
+            }
+        return out
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.rc_free(self._handle)
             self._handle = None

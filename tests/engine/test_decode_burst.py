@@ -8,8 +8,6 @@ semantics the fusion must preserve: greedy outputs identical to the k=1 path,
 EOS/max_tokens finishing mid-burst trimmed, chunked prefill still interleaves.
 """
 
-import asyncio
-
 import pytest
 
 from llmlb_tpu.engine.presets import get_preset

@@ -1,0 +1,153 @@
+"""Native router core (native/router_core.cpp) vs the Python LoadManager.
+
+The C++ core must be selection-for-selection and counter-for-counter
+identical to the pure-Python implementation — it is the same state machine
+(EMA α=0.2, unmeasured-first probe, telemetry tie-break, per-model
+round-robin, active caps) compiled. A randomized workload is replayed
+against both and every observable compared.
+"""
+
+import random
+
+import pytest
+
+from llmlb_tpu.gateway.balancer import LoadManager
+from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.types import (
+    AcceleratorInfo,
+    Endpoint,
+    EndpointStatus,
+    EndpointType,
+    TpsApiKind,
+)
+
+
+def _endpoint(i: int, pressure: float | None = None,
+              queue_depth: int = 0) -> Endpoint:
+    ep = Endpoint(
+        name=f"e{i}", base_url=f"http://e{i}:1", id=f"ep{i}",
+        endpoint_type=EndpointType.OPENAI_COMPATIBLE,
+        status=EndpointStatus.ONLINE,
+    )
+    if pressure is not None or queue_depth:
+        import time
+
+        ep.accelerator = AcceleratorInfo(
+            hbm_used_bytes=int((pressure or 0.0) * 1_000_000),
+            hbm_total_bytes=1_000_000,
+            queue_depth=queue_depth,
+            sampled_at=time.time(),
+        )
+    return ep
+
+
+@pytest.fixture
+def pair():
+    cfgq = QueueConfig(max_active_per_endpoint=3)
+    native = LoadManager(cfgq, use_native=True)
+    if native._rc is None:
+        pytest.skip("native router core not built")
+    python = LoadManager(cfgq, use_native=False)
+    return native, python
+
+
+def test_randomized_parity(pair):
+    native, python = pair
+    rng = random.Random(7)
+    endpoints = [_endpoint(i) for i in range(4)]
+    model_names = ["m0", "m1"]
+    leases = {"native": [], "python": []}
+
+    for step in range(400):
+        op = rng.random()
+        if op < 0.35:
+            eid = f"ep{rng.randrange(4)}"
+            model = rng.choice(model_names)
+            tokens = rng.randrange(1, 500)
+            dur = rng.uniform(0.01, 3.0)
+            for mgr in (native, python):
+                mgr.update_tps(eid, model, TpsApiKind.CHAT, tokens, dur)
+        elif op < 0.7:
+            model = rng.choice(model_names)
+            got_n = native.try_admit(endpoints, model, TpsApiKind.CHAT)
+            got_p = python.try_admit(endpoints, model, TpsApiKind.CHAT)
+            assert (got_n is None) == (got_p is None), f"step {step}"
+            if got_n is not None:
+                assert got_n[0].id == got_p[0].id, f"step {step}"
+                leases["native"].append(got_n[1])
+                leases["python"].append(got_p[1])
+        elif op < 0.9:
+            if leases["native"]:
+                i = rng.randrange(len(leases["native"]))
+                leases["native"].pop(i).complete()
+                leases["python"].pop(i).complete()
+        else:
+            eid = f"ep{rng.randrange(4)}"
+            native.clear_tps_for_endpoint(eid)
+            python.clear_tps_for_endpoint(eid)
+
+        for ep in endpoints:
+            assert native.active_count(ep.id) == python.active_count(ep.id)
+        for ep in endpoints:
+            for model in model_names:
+                tn = native.get_tps(ep.id, model, TpsApiKind.CHAT)
+                tp = python.get_tps(ep.id, model, TpsApiKind.CHAT)
+                if tp is None:
+                    assert tn is None
+                else:
+                    assert tn == pytest.approx(tp, rel=1e-12)
+
+    sn, sp = native.stats(), python.stats()
+    assert sn["total_requests"] == sp["total_requests"]
+    assert sn["active_requests"] == sp["active_requests"]
+    assert sn["tracked_tps_keys"] == sp["tracked_tps_keys"]
+
+
+def test_telemetry_tiebreak_parity(pair):
+    """Unmeasured endpoints tie at +inf; telemetry must break the tie the
+    same way on both paths (pressured endpoint demoted)."""
+    native, python = pair
+    eps = [
+        _endpoint(0, pressure=0.99),   # heavily HBM-pressured
+        _endpoint(1, pressure=0.2),    # healthy
+    ]
+    for _ in range(4):
+        n = native.select_endpoint(eps, "m", TpsApiKind.CHAT)
+        p = python.select_endpoint(eps, "m", TpsApiKind.CHAT)
+        assert n.id == p.id == "ep1"
+
+
+def test_round_robin_parity(pair):
+    """All-unmeasured equal-penalty endpoints rotate identically."""
+    native, python = pair
+    eps = [_endpoint(i) for i in range(3)]
+    seq_n = [native.select_endpoint(eps, "m", TpsApiKind.CHAT).id
+             for _ in range(7)]
+    seq_p = [python.select_endpoint(eps, "m", TpsApiKind.CHAT).id
+             for _ in range(7)]
+    assert seq_n == seq_p
+    assert len(set(seq_n[:3])) == 3  # genuine rotation
+
+
+def test_rejected_samples_create_no_keys(pair):
+    """tokens<=0 / duration<=0 samples are dropped without creating a
+    tracked key on either path (phantom keys skewed tracked_tps_keys)."""
+    native, python = pair
+    for mgr in pair:
+        mgr.update_tps("ep0", "m", TpsApiKind.CHAT, 0, 1.0)
+        mgr.update_tps("ep0", "m", TpsApiKind.CHAT, 10, 0.0)
+    assert native.stats()["tracked_tps_keys"] == 0
+    assert python.stats()["tracked_tps_keys"] == 0
+    assert native.tps_snapshot() == {}
+    assert python.tps_snapshot() == {}
+
+
+def test_seed_and_snapshot_parity(pair):
+    native, python = pair
+    for mgr in pair:
+        mgr.seed_tps("ep0", "m", TpsApiKind.CHAT, 123.456, samples=5)
+        mgr.update_tps("ep0", "m", TpsApiKind.CHAT, 100, 1.0)
+    sn = native.tps_snapshot()["ep0:m:chat"]
+    sp = python.tps_snapshot()["ep0:m:chat"]
+    assert sn["ema_tps"] == pytest.approx(sp["ema_tps"])
+    assert sn["samples"] == sp["samples"]
