@@ -92,6 +92,7 @@ class EngineAPI:
         self.asr = asr  # engine.asr.AsrEngine | None
         self.tts = tts  # engine.tts.TtsEngine | None
         self.image = image  # engine.image.ImageEngine | None
+        self._profiling = False  # one capture at a time (jax global tracer)
 
     # ------------------------------------------------------------- inventory
 
@@ -258,6 +259,59 @@ class EngineAPI:
                 "model": self.engine.model_id,
             }
         )
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """POST /debug/profile {"seconds": N} — capture a jax.profiler device
+        trace of the live serving loop (XLA ops, Pallas kernels, transfers)
+        and return the trace directory for TensorBoard/xprof. The reference
+        has no profiler (SURVEY §5 'no flamegraph/pprof tooling'); on TPU
+        this is how an operator answers 'where do my step milliseconds go'."""
+        import os
+        import tempfile
+
+        import jax
+
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        try:
+            seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
+        except (TypeError, ValueError):
+            return _error(400, "'seconds' must be a number")
+        # Traces always land under a server-controlled root — the engine port
+        # is unauthenticated, so a client-supplied path would be an arbitrary
+        # directory-write primitive.
+        root = os.environ.get("LLMLB_TRACE_DIR") or tempfile.gettempdir()
+        os.makedirs(root, exist_ok=True)
+        out_dir = tempfile.mkdtemp(prefix="llmlb-trace-", dir=root)
+        if self._profiling:
+            return _error(409, "a profile capture is already running")
+        self._profiling = True
+        started = False
+        try:
+            jax.profiler.start_trace(out_dir)
+            started = True
+            await asyncio.sleep(seconds)
+        except Exception as e:
+            return _error(500, f"profiler failed: {e}")
+        finally:
+            # stop on EVERY exit — a client disconnect cancels this handler
+            # with a BaseException, and the global tracer must not keep
+            # recording forever.
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    log.exception("profiler stop failed")
+            self._profiling = False
+        return web.json_response({
+            "trace_dir": out_dir,
+            "seconds": seconds,
+            "hint": "tensorboard --logdir <trace_dir> (profile plugin)",
+        })
 
     # ------------------------------------------------------ chat completions
 
@@ -599,6 +653,7 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     app.router.add_get("/api/health", api.health)
     app.router.add_get("/metrics", api.prometheus_metrics)
     app.router.add_get("/api/system", api.system)
+    app.router.add_post("/debug/profile", api.debug_profile)
 
     if owns_engine:
         async def on_shutdown(app):

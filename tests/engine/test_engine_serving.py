@@ -330,3 +330,43 @@ async def test_engine_server_prometheus_endpoint():
     finally:
         await client.close()
         engine.core.stop()
+
+
+async def test_engine_server_profile_endpoint(tmp_path):
+    """POST /debug/profile captures a jax.profiler trace of the serving loop
+    and rejects invalid durations gracefully (SURVEY §5 profiling hook)."""
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+
+    engine = Engine.from_preset(
+        "debug-tiny", num_slots=2, slot_capacity=64, prefill_buckets=(16,)
+    )
+    app = create_engine_app(engine)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    os.environ["LLMLB_TRACE_DIR"] = str(tmp_path)
+    try:
+        resp = await client.post("/debug/profile", json={"seconds": 0.2})
+        assert resp.status == 200
+        body = await resp.json()
+        # traces are confined to the server-controlled root: the engine port
+        # is unauthenticated, so clients must not pick write paths
+        assert body["trace_dir"].startswith(str(tmp_path))
+        captured = []
+        for _root, _dirs, files in os.walk(body["trace_dir"]):
+            captured += files
+        assert captured, "profiler produced no trace files"
+
+        # invalid durations are rejected with a structured 400
+        resp = await client.post("/debug/profile", json={"seconds": "abc"})
+        assert resp.status == 400
+        resp = await client.post("/debug/profile", json=[1])
+        assert resp.status == 400
+    finally:
+        os.environ.pop("LLMLB_TRACE_DIR", None)
+        await client.close()
+        engine.core.stop()
