@@ -281,18 +281,22 @@ class EngineAPI:
             seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
         except (TypeError, ValueError):
             return _error(400, "'seconds' must be a number")
+        if self._profiling:
+            return _error(409, "a profile capture is already running")
         # Traces always land under a server-controlled root — the engine port
         # is unauthenticated, so a client-supplied path would be an arbitrary
         # directory-write primitive.
         root = os.environ.get("LLMLB_TRACE_DIR") or tempfile.gettempdir()
         os.makedirs(root, exist_ok=True)
         out_dir = tempfile.mkdtemp(prefix="llmlb-trace-", dir=root)
-        if self._profiling:
-            return _error(409, "a profile capture is already running")
         self._profiling = True
         started = False
+        loop = asyncio.get_running_loop()
         try:
-            jax.profiler.start_trace(out_dir)
+            # start/stop serialize the trace on-thread; keep the event loop
+            # (and every in-flight stream) responsive by pushing them to the
+            # executor like the other blocking calls in this server.
+            await loop.run_in_executor(None, jax.profiler.start_trace, out_dir)
             started = True
             await asyncio.sleep(seconds)
         except Exception as e:
@@ -302,10 +306,17 @@ class EngineAPI:
             # with a BaseException, and the global tracer must not keep
             # recording forever.
             if started:
+                stop_future = loop.run_in_executor(
+                    None, jax.profiler.stop_trace
+                )
                 try:
-                    jax.profiler.stop_trace()
-                except Exception:
-                    log.exception("profiler stop failed")
+                    # shield: the executor call runs to completion even if
+                    # this (already-cancelled) handler is interrupted again
+                    # at the await — BaseException because that interrupt is
+                    # a CancelledError, and _profiling must still reset.
+                    await asyncio.shield(stop_future)
+                except BaseException:
+                    log.exception("profiler stop interrupted")
             self._profiling = False
         return web.json_response({
             "trace_dir": out_dir,

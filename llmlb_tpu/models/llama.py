@@ -2,9 +2,12 @@
 
 TPU-first design decisions:
 - Pure functions over a flat param pytree; no Module framework. Everything jits.
-- All layers are *stacked* along a leading axis and iterated with `lax.scan`:
-  one layer gets compiled once, not num_layers times — compile time stays flat
-  even for 80-layer configs.
+- All layers are *stacked* along a leading axis. Prefill/extend iterate them
+  with `lax.scan` (one layer compiles once — prefill compile time stays flat
+  even for 80-layer configs); decode UNROLLS the loop so each layer updates
+  the donated KV cache in place at a static index — scanning the cache
+  materialized full-cache copies per layer under the engine's burst scan
+  (see _decode_impl).
 - Serving-shaped entry points: `prefill` (bucketed [B, T] prompts into fresh KV
   slots) and `decode_step` ([B] one token per slot). Both have fully static
   shapes; raggedness is carried by `prompt_lens` / `seq_lens` masks.
@@ -316,7 +319,17 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
 
 def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
                  *, stacked_names=None, mlp_fn=_default_mlp_fn):
-    """Shared one-token decode body for every model family."""
+    """Shared one-token decode body for every model family.
+
+    The layer loop is UNROLLED (static layer indices) rather than a
+    lax.scan with the caches as scan inputs/outputs. Scanning the cache
+    slices it per layer and re-stacks the outputs into fresh buffers, and
+    under the engine's k-step burst scan XLA materialized full-cache copies
+    every layer — measured 40 ms/step on a v5e for a 2 GiB model whose
+    weight-streaming bound is ~3 ms (bench_runs/MEASUREMENTS.md). Unrolled,
+    each layer does one [B,1,K,D] scatter into the donated full cache at a
+    static layer index and reads a static slice for attention, which XLA
+    keeps in place. Decode programs are tiny, so L× code growth is cheap."""
     b = input_ids.shape[0]
     capacity = cache_k.shape[2]
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -327,23 +340,27 @@ def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
     batch_idx = jnp.arange(b)
 
     x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
-    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+    names = stacked_names or _layer_stacked_names(cfg)
 
-    def layer(carry_x, layer_in):
-        lp, ck, cv = layer_in
+    for layer_idx in range(cfg.num_layers):
+        lp = {n: params[n][layer_idx] for n in names}
 
-        def attn_fn(q, k, v):
-            nonlocal ck, cv  # cache write precedes attention over the cache
-            ck = ck.at[batch_idx, write_pos].set(k[:, 0].astype(ck.dtype))
-            cv = cv.at[batch_idx, write_pos].set(v[:, 0].astype(cv.dtype))
-            return gqa_attention_decode(q, ck, cv, write_pos + 1)
+        def attn_fn(q, k, v, layer_idx=layer_idx):
+            nonlocal cache_k, cache_v  # write precedes attention over the cache
+            cache_k = cache_k.at[layer_idx, batch_idx, write_pos].set(
+                k[:, 0].astype(cache_k.dtype)
+            )
+            cache_v = cache_v.at[layer_idx, batch_idx, write_pos].set(
+                v[:, 0].astype(cache_v.dtype)
+            )
+            return gqa_attention_decode(
+                q, cache_k[layer_idx], cache_v[layer_idx], write_pos + 1
+            )
 
-        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
-        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + mlp_fn(lp, h, None)
-        return carry_x, (ck, cv)
+        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn)
+        h = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+        x = x + mlp_fn(lp, h, None)
 
-    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
     logits = _unembed(cfg, params, x[:, 0])
     return logits, cache_k, cache_v
 
