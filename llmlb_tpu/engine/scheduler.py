@@ -107,6 +107,11 @@ class _Slot:
     prefilling: bool = False
     prefill_pos: int = 0
     last_emit_at: float = 0.0  # inter-token latency tracking
+    # The first token is sampled on-device at activation and emitted with the
+    # NEXT decode fetch instead of its own host readback — per-insert syncs
+    # cost a full host↔device round trip each (93 ms over the axon tunnel)
+    # and serialized TTFT under bursty load.
+    first_pending: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -643,7 +648,9 @@ class EngineCore:
                        logits) -> None:
         """Sample the first token from prefill logits and land the slot's
         device-side state in one scatter (insert-time only; the decode hot
-        loop never uploads host state)."""
+        loop never uploads host state). The sampled token stays ON DEVICE —
+        it is emitted with the next decode fetch (_decode_active prepends the
+        pre-burst last_tokens row), so activation costs no host sync."""
         self._seq_lens[slot_id] = n
         self._key, sk = jax.random.split(self._key)
         s = request.sampling
@@ -652,20 +659,18 @@ class EngineCore:
             logits, sk, temp[None], jnp.float32(s.top_p)[None],
             jnp.int32(s.top_k)[None],
         )[0]
-        if self._replicate is not None:  # make the scalar host-fetchable
-            first = self._replicate(first)
         self._d_temps = self._d_temps.at[slot_id].set(temp)
         self._d_top_ps = self._d_top_ps.at[slot_id].set(s.top_p)
         self._d_top_ks = self._d_top_ks.at[slot_id].set(s.top_k)
         self._d_seq_lens = self._d_seq_lens.at[slot_id].set(n)
         self._d_last_tokens = self._d_last_tokens.at[slot_id].set(first)
 
-        request.first_token_at = time.monotonic()
-        self.metrics.record_ttft(request.first_token_at - request.submitted_at)
         # last_emit_at starts 0 so the FIRST token records no inter-token
-        # latency (_emit sets it for the tokens that follow)
-        self.slots[slot_id].last_emit_at = 0.0
-        self._emit(slot_id, int(first))
+        # latency; first_token_at is stamped when the token actually reaches
+        # the host (_emit), keeping TTFT client-honest.
+        slot = self.slots[slot_id]
+        slot.last_emit_at = 0.0
+        slot.first_pending = True
 
     def _build_decode_many(self, k: int) -> Callable:
         """Jit a k-step decode: lax.scan feeds each step's sampled tokens
@@ -686,10 +691,15 @@ class EngineCore:
                 toks = sample_tokens(logits, step_key, temps, top_ps, top_ks)
                 return (toks, lens + 1, ck, cv), toks
 
+            first_in = last  # pre-burst tokens: pending first emissions
             (last, lens, cache_k, cache_v), toks = jax.lax.scan(
                 body, (last, lens, cache_k, cache_v), keys
             )
-            return last, lens, cache_k, cache_v, toks  # toks [k, SLOTS]
+            # One fetchable array [k+1, SLOTS]: row 0 carries the pre-burst
+            # last tokens so newly activated slots' first tokens ride the
+            # same host readback as the burst output.
+            toks = jnp.concatenate([first_in[None, :], toks], axis=0)
+            return last, lens, cache_k, cache_v, toks
 
         return jax.jit(many, donate_argnums=(3, 4))
 
@@ -714,21 +724,16 @@ class EngineCore:
                 self._d_temps, self._d_top_ps, self._d_top_ks, sk,
             )
             tokens = self._fetch_tokens(toks_dev)  # ONE D2H sync per k tokens
-            # Burst tokens reach the host back-to-back, so wall-clock gaps
-            # between _emit calls are ~0 and would poison the ITL histogram;
-            # record the amortized per-token pacing of the burst instead.
-            itl = (time.monotonic() - burst_start) / k
-            for t in range(k):
-                for i in active:
-                    slot = self.slots[i]
-                    # finished mid-burst (EOS / max_tokens / capacity):
-                    # trim this slot's remaining burst tokens
-                    if slot.request is None or slot.prefilling:
-                        continue
-                    self._seq_lens[i] += 1
-                    self._emit(i, int(tokens[t, i]), itl=itl)
+            # Tokens reach the host back-to-back, so wall-clock gaps between
+            # _emit calls are ~0 and would poison the ITL histogram; record
+            # the amortized per-token pacing of the burst instead.
+            self._emit_fetched(
+                tokens, active, itl=(time.monotonic() - burst_start) / k
+            )
             return True
 
+        step_start = time.monotonic()
+        first_in = self._d_last_tokens  # pre-step tokens: pending firsts
         logits, self.cache_k, self.cache_v = self.family.decode_step(
             self.params,
             self.cfg,
@@ -743,11 +748,36 @@ class EngineCore:
         )
         self._d_last_tokens = tokens_dev
         self._d_seq_lens = self._d_seq_lens + 1
-        tokens = self._fetch_tokens(tokens_dev)  # the one D2H sync per step
-        self._seq_lens[active] += 1
-        for i in active:
-            self._emit(i, int(tokens[i]))
+        # the one D2H sync per step; row 0 carries deferred first emissions.
+        # itl = this step's duration: a deferred first and its decode token
+        # land in the same fetch, so the wall gap between them is ~0 and
+        # would skew the histogram exactly like an unamortized burst.
+        tokens = self._fetch_tokens(jnp.stack([first_in, tokens_dev]))
+        self._emit_fetched(
+            tokens, active, itl=time.monotonic() - step_start
+        )
         return True
+
+    def _emit_fetched(self, tokens, active: list[int],
+                      itl: float | None) -> None:
+        """Deliver one fetched token matrix [rows, SLOTS]: row 0 holds
+        deferred first emissions for slots activated since the previous
+        fetch (no seq_len advance — the first token is prefill output, not
+        a decode step); rows 1.. are decode steps. Slots that finish
+        mid-matrix (EOS / max_tokens / capacity / cancel) have their
+        remaining tokens trimmed."""
+        for i in active:
+            slot = self.slots[i]
+            if slot.first_pending and slot.request is not None:
+                slot.first_pending = False
+                self._emit(i, int(tokens[0, i]))
+        for t in range(1, tokens.shape[0]):
+            for i in active:
+                slot = self.slots[i]
+                if slot.request is None or slot.prefilling:
+                    continue
+                self._seq_lens[i] += 1
+                self._emit(i, int(tokens[t, i]), itl=itl)
 
     def _emit(self, slot_id: int, token: int,
               itl: float | None = None) -> None:
@@ -765,9 +795,13 @@ class EngineCore:
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
+            slot.first_pending = False
             return
         slot.generated += 1
         now = time.monotonic()
+        if request.first_token_at is None:
+            request.first_token_at = now
+            self.metrics.record_ttft(now - request.submitted_at)
         if not slot.last_emit_at:
             self.metrics.record_emit(None)  # first token: no inter-token gap
         else:
@@ -798,6 +832,7 @@ class EngineCore:
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
+            slot.first_pending = False
 
     def _fail_all(self, message: str) -> None:
         for slot in self.slots:
@@ -809,6 +844,7 @@ class EngineCore:
             slot.prefill_pos = 0
             slot.generated = 0
             slot.last_emit_at = 0.0
+            slot.first_pending = False
         while True:
             try:
                 self.pending.get_nowait().events.put(("error", message))

@@ -289,35 +289,38 @@ class EngineAPI:
         root = os.environ.get("LLMLB_TRACE_DIR") or tempfile.gettempdir()
         os.makedirs(root, exist_ok=True)
         out_dir = tempfile.mkdtemp(prefix="llmlb-trace-", dir=root)
+        # The whole capture is ONE uncancellable executor job: start, sleep,
+        # stop happen atomically on a worker thread, so a client disconnect
+        # (which cancels this handler) can neither leave the global tracer
+        # recording nor race a new start against an in-flight stop. The
+        # event loop (and every in-flight stream) stays responsive.
+        def _capture() -> None:
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+
         self._profiling = True
-        started = False
         loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(None, _capture)
+
+        def _done(f) -> None:
+            # _profiling resets exactly when the capture actually ended —
+            # until then new requests correctly 409.
+            self._profiling = False
+            try:
+                f.result()
+            except Exception:
+                log.exception("profile capture failed")
+
+        fut.add_done_callback(_done)
         try:
-            # start/stop serialize the trace on-thread; keep the event loop
-            # (and every in-flight stream) responsive by pushing them to the
-            # executor like the other blocking calls in this server.
-            await loop.run_in_executor(None, jax.profiler.start_trace, out_dir)
-            started = True
-            await asyncio.sleep(seconds)
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            raise  # client gone; the capture completes in the executor
         except Exception as e:
             return _error(500, f"profiler failed: {e}")
-        finally:
-            # stop on EVERY exit — a client disconnect cancels this handler
-            # with a BaseException, and the global tracer must not keep
-            # recording forever.
-            if started:
-                stop_future = loop.run_in_executor(
-                    None, jax.profiler.stop_trace
-                )
-                try:
-                    # shield: the executor call runs to completion even if
-                    # this (already-cancelled) handler is interrupted again
-                    # at the await — BaseException because that interrupt is
-                    # a CancelledError, and _profiling must still reset.
-                    await asyncio.shield(stop_future)
-                except BaseException:
-                    log.exception("profiler stop interrupted")
-            self._profiling = False
         return web.json_response({
             "trace_dir": out_dir,
             "seconds": seconds,

@@ -111,7 +111,11 @@ def test_decode_progresses_during_long_prefill(tiny_cfg, tiny_params):
                     sampling=SamplingParams(temperature=0.0, max_tokens=200))
     core.submit(short)
     assert core._try_insert()
-    assert short.first_token_at is not None  # activated, first token emitted
+    # activated: first token sampled on device, emitted with the next
+    # decode fetch (deferred — activation itself costs no host sync)
+    assert core.slots[0].first_pending
+    assert core._decode_active()
+    assert short.first_token_at is not None
 
     # 130-token prompt: > largest bucket (32) -> chunked (5 chunks)
     long = Request(prompt_ids=list(range(1, 131)),
@@ -135,7 +139,9 @@ def test_decode_progresses_during_long_prefill(tiny_cfg, tiny_params):
         assert iterations < 50
     assert iterations == (130 + 31) // 32  # ceil(130/32) = 5 chunks
     assert short_tokens_during_prefill >= 4
-    assert long.first_token_at is not None  # activated on the final chunk
+    # activated on the final chunk; its first token rode the same loop
+    # iteration's decode fetch (deferred emission)
+    assert long.first_token_at is not None
 
     # run the loop to completion for the long request
     core.start()
