@@ -349,12 +349,6 @@ class EngineCore:
                 return b
         raise ValueError(f"no prefill bucket for prompt of {n} tokens")
 
-    def _free_slot(self) -> int | None:
-        for i, s in enumerate(self.slots):
-            if s.request is None:
-                return i
-        return None
-
     # ------------------------------------------------------- multihost plans
 
     def _is_cancelled(self, request: Request) -> bool:
@@ -495,72 +489,169 @@ class EngineCore:
         self._d_seq_lens = jnp.zeros((self.num_slots,), jnp.int32)
         self._d_last_tokens = jnp.zeros((self.num_slots,), jnp.int32)
 
+    # Same-bucket pending prompts prefill TOGETHER in one dispatch (padded to
+    # a power-of-two group so the jit cache stays at log2 sizes). Bounded so
+    # a deep backlog cannot starve decode for longer than one group's
+    # prefill; the loop comes back around for the rest.
+    MAX_PREFILL_GROUP = 8
+
     def _try_insert(self) -> bool:
-        slot_id = self._free_slot()
-        if slot_id is None:
+        free = [i for i, s in enumerate(self.slots) if s.request is None]
+        if not free:
             return False
-        try:
-            request = self.pending.get_nowait()
-        except queue.Empty:
-            return False
-        if self._is_cancelled(request):
-            request.events.put(("done", "cancelled"))
-            self.metrics.record_request_done("cancelled")
-            self._cancelled_effective.discard(request.request_id)
-            return True
-
-        n = len(request.prompt_ids)
-        # Cap generation so the slot cache can hold prompt + output.
-        room = self.slot_capacity - n - 1
-        if room <= 0:
-            request.events.put(("error", "prompt does not fit slot capacity"))
-            self.metrics.record_request_done("error")
-            return True
-
-        slot = self.slots[slot_id]
         max_oneshot = self.prefill_buckets[-1] if self.prefill_buckets else 0
-        if n > max_oneshot:
-            if self._use_cp_prefill and hasattr(
-                self.family, "make_context_parallel_prefill"
-            ):
-                # Ring-attention prefill: one distributed pass over the mesh
-                # sp axis fills the whole prompt's KV (per-chip sequence cost
-                # ~n/sp), then scatters into the slot row.
-                self._cp_prefill_into_slot(slot_id, request, n)
-                return True
-            # Single-chip long prompt: chunked prefill. Claim the slot, park
-            # its device seq_len at capacity-1 (batched decode's garbage
-            # writes for this row land in the unused last cell), and let
-            # _advance_prefill feed chunks between decode steps.
-            slot.request = request
-            slot.generated = 0
-            slot.prefilling = True
-            slot.prefill_pos = 0
-            self._seq_lens[slot_id] = 0
-            self._d_seq_lens = self._d_seq_lens.at[slot_id].set(
-                self.slot_capacity - 1
-            )
-            return True
+        handled = False
+        inserted = 0  # long inserts count toward the group cap too
+        batch: list[tuple[int, Request, int]] = []  # (slot_id, request, n)
+        while free and len(batch) + inserted < self.MAX_PREFILL_GROUP:
+            try:
+                request = self.pending.get_nowait()
+            except queue.Empty:
+                break
+            if self._is_cancelled(request):
+                request.events.put(("done", "cancelled"))
+                self.metrics.record_request_done("cancelled")
+                self._cancelled_effective.discard(request.request_id)
+                handled = True
+                continue
+            n = len(request.prompt_ids)
+            # Cap generation so the slot cache can hold prompt + output.
+            if self.slot_capacity - n - 1 <= 0:
+                request.events.put(
+                    ("error", "prompt does not fit slot capacity")
+                )
+                self.metrics.record_request_done("error")
+                handled = True
+                continue
+            slot_id = free.pop(0)
+            if n > max_oneshot:
+                heavy = self._insert_long(slot_id, request, n)
+                handled = True
+                inserted += 1
+                if heavy:
+                    # a context-parallel prefill is a full synchronous pass;
+                    # get back to decode before taking another
+                    break
+                continue
+            # Claim the slot BEFORE any dispatch: a failed prefill then
+            # reaches these requests through _fail_all instead of leaving
+            # their event queues silent forever.
+            self.slots[slot_id].request = request
+            self.slots[slot_id].generated = 0
+            batch.append((slot_id, request, n))
 
-        bucket = self._bucket_for(n)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = request.prompt_ids
+        if not batch:
+            return handled
+
+        # one prefill dispatch per length bucket present in the batch
+        by_bucket: dict[int, list[tuple[int, Request, int]]] = {}
+        for entry in batch:
+            by_bucket.setdefault(self._bucket_for(entry[2]), []).append(entry)
+        for bucket, group in by_bucket.items():
+            self._prefill_group(bucket, group)
+        return True
+
+    def _insert_long(self, slot_id: int, request: Request, n: int) -> bool:
+        """Claim a slot for a prompt beyond the largest one-shot bucket.
+        Returns True when it ran a heavy synchronous prefill (CP path)."""
+        slot = self.slots[slot_id]
+        if self._use_cp_prefill and hasattr(
+            self.family, "make_context_parallel_prefill"
+        ):
+            # Ring-attention prefill: one distributed pass over the mesh
+            # sp axis fills the whole prompt's KV (per-chip sequence cost
+            # ~n/sp), then scatters into the slot row.
+            self._cp_prefill_into_slot(slot_id, request, n)
+            return True
+        # Single-chip long prompt: chunked prefill. Claim the slot, park
+        # its device seq_len at capacity-1 (batched decode's garbage
+        # writes for this row land in the unused last cell), and let
+        # _advance_prefill feed chunks between decode steps.
+        slot.request = request
+        slot.generated = 0
+        slot.prefilling = True
+        slot.prefill_pos = 0
+        self._seq_lens[slot_id] = 0
+        self._d_seq_lens = self._d_seq_lens.at[slot_id].set(
+            self.slot_capacity - 1
+        )
+        return False
+
+    def _prefill_group(self, bucket: int,
+                       group: list[tuple[int, Request, int]]) -> None:
+        """Prefill G same-bucket prompts in one dispatch, padded to the next
+        power of two by repeating the last row — duplicate scatters write
+        identical data to the same slot, so padding rows are free."""
+        g = len(group)
+        padded = 1
+        while padded < g:
+            padded *= 2
+        ids = np.zeros((padded, bucket), np.int32)
+        lens = np.zeros((padded,), np.int32)
+        slot_ids = np.zeros((padded,), np.int32)
+        for row, (slot_id, request, n) in enumerate(group):
+            ids[row, :n] = request.prompt_ids
+            lens[row] = n
+            slot_ids[row] = slot_id
+        ids[g:] = ids[g - 1]
+        lens[g:] = lens[g - 1]
+        slot_ids[g:] = slot_ids[g - 1]
 
         logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
             self.params,
             self.cfg,
             jnp.asarray(ids),
-            jnp.asarray([n], np.int32),
-            jnp.asarray([slot_id], np.int32),
+            jnp.asarray(lens),
+            jnp.asarray(slot_ids),
             self.cache_k,
             self.cache_v,
             self.mesh,
         )
+        self._activate_group(group, slot_ids, lens, logits)
 
-        slot.request = request
-        slot.generated = 0
-        self._activate_slot(slot_id, request, n, logits)
-        return True
+    def _activate_group(self, group: list[tuple[int, Request, int]],
+                        padded_slot_ids: np.ndarray, padded_lens: np.ndarray,
+                        logits) -> None:
+        """Batched activation: ONE sample_tokens over the padded logits and
+        one vector scatter per device array — ~6 dispatches for the whole
+        group instead of ~6 per request. Padding rows repeat the last real
+        row, so their scatters rewrite identical values."""
+        padded = len(padded_slot_ids)
+        temps = np.ones((padded,), np.float32)
+        top_ps = np.ones((padded,), np.float32)
+        top_ks = np.zeros((padded,), np.int32)
+        for row, (_slot_id, request, _n) in enumerate(group):
+            s = request.sampling
+            temps[row] = s.temperature
+            top_ps[row] = s.top_p
+            top_ks[row] = s.top_k
+        temps[len(group):] = temps[len(group) - 1]
+        top_ps[len(group):] = top_ps[len(group) - 1]
+        top_ks[len(group):] = top_ks[len(group) - 1]
+
+        self._key, sk = jax.random.split(self._key)
+        d_temps = jnp.asarray(temps)
+        d_top_ps = jnp.asarray(top_ps)
+        d_top_ks = jnp.asarray(top_ks)
+        firsts = sample_tokens(logits, sk, d_temps, d_top_ps, d_top_ks)
+        idx = jnp.asarray(padded_slot_ids)
+        self._d_temps = self._d_temps.at[idx].set(d_temps)
+        self._d_top_ps = self._d_top_ps.at[idx].set(d_top_ps)
+        self._d_top_ks = self._d_top_ks.at[idx].set(d_top_ks)
+        self._d_seq_lens = self._d_seq_lens.at[idx].set(
+            jnp.asarray(padded_lens)
+        )
+        self._d_last_tokens = self._d_last_tokens.at[idx].set(firsts)
+
+        for slot_id, request, n in group:
+            self._seq_lens[slot_id] = n
+            slot = self.slots[slot_id]
+            slot.request = request
+            slot.generated = 0
+            # last_emit_at 0 ⇒ the first token records no inter-token gap;
+            # it is emitted with the next decode fetch (first_pending).
+            slot.last_emit_at = 0.0
+            slot.first_pending = True
 
     def _cp_bucket_for(self, n: int) -> int:
         """Padded length for the context-parallel prefill jit cache: next
@@ -646,31 +737,17 @@ class EngineCore:
 
     def _activate_slot(self, slot_id: int, request: Request, n: int,
                        logits) -> None:
-        """Sample the first token from prefill logits and land the slot's
-        device-side state in one scatter (insert-time only; the decode hot
-        loop never uploads host state). The sampled token stays ON DEVICE —
-        it is emitted with the next decode fetch (_decode_active prepends the
-        pre-burst last_tokens row), so activation costs no host sync."""
-        self._seq_lens[slot_id] = n
-        self._key, sk = jax.random.split(self._key)
-        s = request.sampling
-        temp = jnp.float32(s.temperature)
-        first = sample_tokens(
-            logits, sk, temp[None], jnp.float32(s.top_p)[None],
-            jnp.int32(s.top_k)[None],
-        )[0]
-        self._d_temps = self._d_temps.at[slot_id].set(temp)
-        self._d_top_ps = self._d_top_ps.at[slot_id].set(s.top_p)
-        self._d_top_ks = self._d_top_ks.at[slot_id].set(s.top_k)
-        self._d_seq_lens = self._d_seq_lens.at[slot_id].set(n)
-        self._d_last_tokens = self._d_last_tokens.at[slot_id].set(first)
-
-        # last_emit_at starts 0 so the FIRST token records no inter-token
-        # latency; first_token_at is stamped when the token actually reaches
-        # the host (_emit), keeping TTFT client-honest.
-        slot = self.slots[slot_id]
-        slot.last_emit_at = 0.0
-        slot.first_pending = True
+        """Single-slot activation (chunked/CP prefill completions): the
+        sampled first token stays ON DEVICE and is emitted with the next
+        decode fetch, so activation costs no host sync. first_token_at is
+        stamped when the token actually reaches the host (_emit), keeping
+        TTFT client-honest."""
+        self._activate_group(
+            [(slot_id, request, n)],
+            np.asarray([slot_id], np.int32),
+            np.asarray([n], np.int32),
+            logits,
+        )
 
     def _build_decode_many(self, k: int) -> Callable:
         """Jit a k-step decode: lax.scan feeds each step's sampled tokens

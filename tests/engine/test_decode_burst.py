@@ -154,3 +154,87 @@ def test_burst_cancellation_mid_stream(cfg):
         assert finish in ("stop", "length")
     finally:
         core.stop()
+
+
+def test_batched_prefill_matches_sequential(cfg):
+    """Same-bucket prompts prefilled together (one padded dispatch) must
+    produce the same greedy outputs as one-at-a-time inserts. The padded
+    rows repeat the last request, so duplicate scatters are exercised too
+    (6 requests -> pow2 pad to 8)."""
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+
+    core_seq = EngineCore(cfg, num_slots=8, slot_capacity=64,
+                          prefill_buckets=(16,), seed=0, decode_burst=1)
+    core_seq.MAX_PREFILL_GROUP = 1  # force one-at-a-time inserts
+    core_seq.start()
+    try:
+        base = _run_greedy(core_seq, prompts, max_tokens=8)
+    finally:
+        core_seq.stop()
+
+    core_batch = EngineCore(cfg, num_slots=8, slot_capacity=64,
+                            prefill_buckets=(16,), seed=0, decode_burst=1)
+    core_batch.start()
+    try:
+        batched = _run_greedy(core_batch, prompts, max_tokens=8)
+    finally:
+        core_batch.stop()
+
+    assert batched == base
+
+
+def test_batched_prefill_mixed_buckets_and_long(cfg):
+    """A drain that mixes buckets and a chunked long prompt: every request
+    finishes and the long one still interleaves."""
+    core = EngineCore(cfg, num_slots=4, slot_capacity=128,
+                      prefill_buckets=(16, 32), seed=0, decode_burst=4)
+    core.start()
+    try:
+        reqs = [
+            Request(prompt_ids=[1] * 4,
+                    sampling=SamplingParams(temperature=0.0, max_tokens=6)),
+            Request(prompt_ids=[2] * 20,  # second bucket
+                    sampling=SamplingParams(temperature=0.0, max_tokens=6)),
+            Request(prompt_ids=list(range(1, 60)),  # > 32: chunked
+                    sampling=SamplingParams(temperature=0.0, max_tokens=4)),
+            Request(prompt_ids=[3] * 5,
+                    sampling=SamplingParams(temperature=0.0, max_tokens=6)),
+        ]
+        for r in reqs:
+            core.submit(r)
+        for r in reqs:
+            tokens, finish = _collect(r)
+            assert finish in ("stop", "length")
+    finally:
+        core.stop()
+
+
+def test_prefill_dispatch_failure_reaches_batched_requests(cfg):
+    """Requests claimed into a prefill batch get terminal events when the
+    dispatch raises — slots are assigned before the dispatch so _fail_all
+    can see them (a silent event queue hangs the HTTP stream forever)."""
+    core = EngineCore(cfg, num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0, decode_burst=1)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("injected prefill failure")
+
+    core.family = type("F", (), {
+        **{k: staticmethod(getattr(core.family, k))
+           for k in dir(core.family) if not k.startswith("__")},
+        "prefill_into_slots": staticmethod(boom),
+    })()
+    core.start()
+    try:
+        reqs = [
+            Request(prompt_ids=[1, 2, 3],
+                    sampling=SamplingParams(temperature=0.0, max_tokens=4))
+            for _ in range(3)
+        ]
+        for r in reqs:
+            core.submit(r)
+        for r in reqs:
+            kind, val = r.events.get(timeout=30)
+            assert kind == "error", (kind, val)
+    finally:
+        core.stop()
