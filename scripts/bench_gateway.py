@@ -1,0 +1,112 @@
+"""Gateway overhead bench: req/s + latency through the full proxy path.
+
+The reference's one committed benchmark is wrk against its Rust router with a
+local upstream — 170,600 req/s, p50 0.249 ms (BASELINE.md). This measures the
+same thing for this gateway: an in-process mock OpenAI upstream, the real app
+(auth, audit, gate, TPS accounting all active), and N concurrent non-streaming
+/v1/chat/completions callers. Run:
+
+    python scripts/bench_gateway.py [--seconds 10] [--concurrency 50]
+
+Prints one JSON line. Python/aiohttp will not reach a Rust router's ceiling;
+the number is tracked honestly in bench_runs/MEASUREMENTS.md and bounds how
+much gateway CPU one TPU engine's request rate can consume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+async def run_bench(seconds: float, concurrency: int) -> dict:
+    from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="bench-model").start()
+    try:
+        gw.register_mock(upstream.url, ["bench-model"])
+        headers = dict(await gw.inference_headers())
+        payload = {
+            "model": "bench-model",
+            "messages": [{"role": "user", "content": "ping"}],
+            "stream": False,
+        }
+
+        # warmup
+        for _ in range(20):
+            resp = await gw.client.post(
+                "/v1/chat/completions", json=payload, headers=headers
+            )
+            assert resp.status == 200, await resp.text()
+            await resp.read()
+
+        latencies: list[float] = []
+        done = 0
+        errors = 0
+        deadline = time.perf_counter() + seconds
+
+        async def worker() -> None:
+            nonlocal done, errors
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    resp = await gw.client.post(
+                        "/v1/chat/completions", json=payload, headers=headers
+                    )
+                    await resp.read()
+                    if resp.status == 200:
+                        done += 1
+                        latencies.append(time.perf_counter() - t0)
+                    else:
+                        errors += 1
+                except Exception:
+                    errors += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        elapsed = time.perf_counter() - t0
+
+        latencies.sort()
+
+        def pct(p: float) -> float:
+            if not latencies:
+                return 0.0
+            return latencies[min(len(latencies) - 1, int(len(latencies) * p))]
+
+        return {
+            "metric": "gateway_proxy_requests_per_sec",
+            "value": round(done / elapsed, 1),
+            "unit": "req/s",
+            "vs_baseline": round(done / elapsed / 170600.51, 5),
+            "requests": done,
+            "errors": errors,
+            "seconds": round(elapsed, 2),
+            "concurrency": concurrency,
+            "p50_ms": round(1000 * pct(0.50), 2),
+            "p90_ms": round(1000 * pct(0.90), 2),
+            "p99_ms": round(1000 * pct(0.99), 2),
+            "native_router": gw.state.load_manager.stats().get(
+                "native_router", False
+            ),
+        }
+    finally:
+        await upstream.stop()
+        await gw.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seconds", type=float, default=10.0)
+    parser.add_argument("--concurrency", type=int, default=50)
+    args = parser.parse_args()
+    result = asyncio.run(run_bench(args.seconds, args.concurrency))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()
