@@ -23,6 +23,7 @@ from aiohttp import web
 from llmlb_tpu.gateway.app_state import AppState, record_daily_stat
 from llmlb_tpu.gateway.balancer import RequestRecord
 from llmlb_tpu.gateway.model_names import to_canonical, to_engine_name
+from llmlb_tpu.gateway.sanitize import sanitize_request_body
 from llmlb_tpu.gateway.token_accounting import (
     StreamingTokenAccumulator,
     estimate_tokens,
@@ -88,6 +89,7 @@ def _record(
     prompt_tokens: int = 0, completion_tokens: int = 0,
     client_ip: str | None = None, auth: dict | None = None,
     error: str | None = None, stream: bool = False,
+    request_body: str | None = None,
 ) -> None:
     duration_ms = (time.monotonic() - started) * 1000.0
     eid = endpoint.id if endpoint else None
@@ -101,12 +103,13 @@ def _record(
         """INSERT INTO request_history
            (id, ts, endpoint_id, endpoint_name, model, api_kind, path,
             status_code, duration_ms, prompt_tokens, completion_tokens,
-            client_ip, api_key_id, user_id, stream, error)
-           VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+            client_ip, api_key_id, user_id, stream, error, request_body)
+           VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
         (uuid.uuid4().hex, time.time(), eid,
          endpoint.name if endpoint else None, model, api_kind.value, path,
          status, duration_ms, prompt_tokens, completion_tokens, client_ip,
-         auth.get("api_key_id"), auth.get("user_id"), int(stream), error),
+         auth.get("api_key_id"), auth.get("user_id"), int(stream), error,
+         request_body),
     )
     if endpoint is not None:
         record_daily_stat(
@@ -182,6 +185,9 @@ async def proxy_openai_post(
     client_ip = request.remote
     auth = request.get("auth")
     prompt_text = prompt_text_fn(body) if prompt_text_fn else ""
+    # stored for the dashboard request-detail view, inline media redacted
+    # (the reference's sanitization contract, implemented)
+    stored_body = sanitize_request_body(body)
 
     try:
         upstream = await state.http.post(
@@ -196,7 +202,8 @@ async def proxy_openai_post(
         lease.fail()
         _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
                 path=path, status=502, started=started, client_ip=client_ip,
-                auth=auth, error=f"{type(e).__name__}: {e}")
+                auth=auth, error=f"{type(e).__name__}: {e}",
+                request_body=stored_body)
         return error_response(
             502, f"upstream endpoint unreachable: {type(e).__name__}",
             "server_error",
@@ -209,7 +216,8 @@ async def proxy_openai_post(
         lease.fail()
         _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
                 path=path, status=502, started=started, client_ip=client_ip,
-                auth=auth, error=f"upstream HTTP {upstream.status}: {detail}")
+                auth=auth, error=f"upstream HTTP {upstream.status}: {detail}",
+                request_body=stored_body)
         return error_response(
             502, f"upstream returned {upstream.status}: {detail}", "server_error"
         )
@@ -218,7 +226,7 @@ async def proxy_openai_post(
     if is_stream and "text/event-stream" in content_type:
         return await _forward_stream(
             request, state, upstream, endpoint, canonical, api_kind, path,
-            started, lease, prompt_text, client_ip, auth,
+            started, lease, prompt_text, client_ip, auth, stored_body,
         )
 
     raw = await upstream.read()
@@ -235,7 +243,7 @@ async def proxy_openai_post(
     _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
             path=path, status=200, started=started,
             prompt_tokens=usage[0], completion_tokens=usage[1],
-            client_ip=client_ip, auth=auth)
+            client_ip=client_ip, auth=auth, request_body=stored_body)
     state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
     return web.Response(
         body=raw, status=200,
@@ -245,7 +253,7 @@ async def proxy_openai_post(
 
 async def _forward_stream(
     request, state: AppState, upstream, endpoint, model, api_kind, path,
-    started, lease, prompt_text, client_ip, auth,
+    started, lease, prompt_text, client_ip, auth, stored_body=None,
 ) -> web.StreamResponse:
     """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120)."""
     resp = web.StreamResponse(
@@ -283,7 +291,7 @@ async def _forward_stream(
         _record(state, endpoint=endpoint, model=model, api_kind=api_kind,
                 path=path, status=status, started=started, prompt_tokens=pt,
                 completion_tokens=ct, client_ip=client_ip, auth=auth,
-                error=error, stream=True)
+                error=error, stream=True, request_body=stored_body)
     return resp
 
 

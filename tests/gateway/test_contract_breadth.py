@@ -227,3 +227,95 @@ def test_inference_scope_grants_models_read():
             await gw.close()
 
     asyncio.run(run())
+
+
+async def test_request_history_redacts_inline_media():
+    """The reference's sanitization contract (openai_request_sanitization_
+    spec.rs, shipped ignored there): inline base64 media must never land in
+    request_history; text content and structure must survive."""
+    from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="mm-model").start()
+    try:
+        gw.register_mock(upstream.url, ["mm-model"])
+        headers = await gw.inference_headers()
+        sensitive_image = "SENSITIVE_IMAGE_BASE64_" + "A" * 600
+        sensitive_audio = "SENSITIVE_AUDIO_BASE64_" + "B" * 600
+        resp = await gw.client.post("/v1/chat/completions", json={
+            "model": "mm-model",
+            "stream": False,
+            "messages": [{
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "describe this"},
+                    {"type": "image_url",
+                     "image_url": {"url": f"data:image/png;base64,{sensitive_image}"}},
+                    {"type": "input_audio",
+                     "input_audio": {"data": sensitive_audio, "format": "wav"}},
+                ],
+            }],
+        }, headers=headers)
+        assert resp.status == 200, await resp.text()
+
+        admin = await gw.admin_headers()
+        resp = await gw.client.get("/api/dashboard/requests", headers=admin)
+        records = (await resp.json())["records"]
+        assert records, "no request history record written"
+        detail = await gw.client.get(
+            f"/api/dashboard/requests/{records[0]['id']}", headers=admin
+        )
+        row = await detail.json()
+        stored = row["request_body"]
+        assert stored, "request_body not stored"
+        assert sensitive_image not in stored
+        assert sensitive_audio not in stored
+        assert "describe this" in stored  # prompt text survives
+        assert "<redacted" in stored
+        assert "data:image/png" in stored  # media TYPE survives for debugging
+    finally:
+        await upstream.stop()
+        await gw.close()
+
+
+def test_sanitizer_edge_cases():
+    """Responses-API string-form media, malformed data: URLs, byte-bounded
+    truncation, and non-base64 'data' values (review findings, pinned)."""
+    import json as _json
+
+    from llmlb_tpu.gateway.sanitize import (
+        MAX_STORED_BODY_BYTES,
+        sanitize_request_body,
+    )
+
+    b64 = "A" * 600
+    # string-form image_url and file_data (Responses API shapes)
+    out = sanitize_request_body({
+        "input": [
+            {"type": "input_image", "image_url": f"data:image/png;base64,{b64}"},
+            {"type": "input_file", "file_data": f"data:application/pdf;base64,{b64}"},
+        ],
+    })
+    assert b64 not in out and out.count("<redacted") == 2
+
+    # malformed data: URL with no comma must not leak through the 'head'
+    out = sanitize_request_body({"url": "data:image/png;base64" + b64})
+    assert b64 not in out and "<redacted" in out
+
+    # long plain-text under a generic 'data' key survives (not base64)
+    prose = ("this is a long plain text tool payload, with spaces and "
+             "punctuation! " * 8)
+    out = sanitize_request_body({"data": prose})
+    assert prose in out
+
+    # base64-looking payload under 'data' is redacted
+    out = sanitize_request_body({"data": b64})
+    assert b64 not in out
+
+    # truncation is byte-bounded even for multi-byte text
+    big = {"text": "漢" * 40_000}  # ~120KB utf-8
+    out = sanitize_request_body(big)
+    assert len(out.encode()) < 2 * MAX_STORED_BODY_BYTES
+    parsed = _json.loads(out)
+    assert parsed["_truncated"] is True
+    assert parsed["_original_bytes"] > MAX_STORED_BODY_BYTES
