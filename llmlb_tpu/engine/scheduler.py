@@ -267,7 +267,20 @@ class EngineCore:
             if decode_burst is None:
                 decode_burst = 8 if jax.default_backend() == "tpu" else 1
         self.decode_burst = max(1, int(decode_burst))
-        self._decode_many: Callable | None = None  # built on first burst
+        self._decode_many: dict[int, Callable] = {}  # per context window
+
+        # Context-window buckets (pow2, up to capacity): every decode reads
+        # only the smallest bucket covering all active sequences, so
+        # attention HBM traffic scales with the context in use instead of
+        # the slot capacity (a 2048-cap cache at 300-token contexts was
+        # spending ~85% of its cache bandwidth on empty cells).
+        buckets = []
+        w = 256
+        while w < self.slot_capacity:
+            buckets.append(w)
+            w *= 2
+        buckets.append(self.slot_capacity)
+        self._window_buckets = tuple(buckets)
 
         # queue.Queue (not SimpleQueue): the multihost plan collector
         # snapshots .queue to find cancelled-but-still-queued requests;
@@ -289,6 +302,42 @@ class EngineCore:
             target=self._loop, name="engine-step-loop", daemon=True
         )
         self._thread.start()
+        if self.decode_burst > 1 and len(self._window_buckets) > 1:
+            # Pre-compile every window-bucket variant off-thread: the first
+            # sequence to cross a bucket boundary must not stall every
+            # in-flight stream behind a multi-second XLA compile.
+            threading.Thread(
+                target=self._prewarm_windows, name="engine-prewarm",
+                daemon=True,
+            ).start()
+
+    def _prewarm_windows(self) -> None:
+        import jax.numpy as _jnp
+
+        def shape_of(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        for w in self._window_buckets:
+            if not self._running:
+                return
+            try:
+                fn = self._decode_many.get(w)
+                if fn is None:
+                    fn = self._build_decode_many(self.decode_burst, w)
+                    self._decode_many[w] = fn
+                fn.lower(
+                    {k: shape_of(v) for k, v in self.params.items()},
+                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
+                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
+                    shape_of(self.cache_k), shape_of(self.cache_v),
+                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.float32),
+                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.float32),
+                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
+                    shape_of(self._key),  # split keys keep this shape/dtype
+                ).compile()
+            except Exception:  # pragma: no cover - best-effort warmup
+                log.exception("window %d prewarm failed (will compile "
+                              "on first use)", w)
 
     def stop(self) -> None:
         if self.coordinator is not None and self.coordinator.is_leader:
@@ -749,7 +798,16 @@ class EngineCore:
             logits,
         )
 
-    def _build_decode_many(self, k: int) -> Callable:
+    def _window_for(self, active: list[int], k: int) -> int:
+        """Smallest context-window bucket covering every active sequence
+        plus the k tokens this dispatch will add."""
+        needed = max(int(self._seq_lens[i]) for i in active) + k + 1
+        for w in self._window_buckets:
+            if w >= needed:
+                return w
+        return self.slot_capacity
+
+    def _build_decode_many(self, k: int, window: int) -> Callable:
         """Jit a k-step decode: lax.scan feeds each step's sampled tokens
         back into the next ON DEVICE, so the host syncs once per k tokens
         instead of once per token. Sampling params are scan-invariant;
@@ -763,7 +821,7 @@ class EngineCore:
             def body(carry, step_key):
                 last, lens, ck, cv = carry
                 logits, ck, cv = family.decode_step(
-                    params, cfg, last, lens, ck, cv, mesh
+                    params, cfg, last, lens, ck, cv, mesh, window=window
                 )
                 toks = sample_tokens(logits, step_key, temps, top_ps, top_ks)
                 return (toks, lens + 1, ck, cv), toks
@@ -792,10 +850,11 @@ class EngineCore:
         k = self.decode_burst
         if k > 1:
             burst_start = time.monotonic()
-            if self._decode_many is None:
-                self._decode_many = self._build_decode_many(k)
+            window = self._window_for(active, k)
+            if window not in self._decode_many:
+                self._decode_many[window] = self._build_decode_many(k, window)
             (self._d_last_tokens, self._d_seq_lens, self.cache_k,
-             self.cache_v, toks_dev) = self._decode_many(
+             self.cache_v, toks_dev) = self._decode_many[window](
                 self.params, self._d_last_tokens, self._d_seq_lens,
                 self.cache_k, self.cache_v,
                 self._d_temps, self._d_top_ps, self._d_top_ks, sk,
@@ -819,6 +878,7 @@ class EngineCore:
             self.cache_k,
             self.cache_v,
             self.mesh,
+            window=self._window_for(active, 1),
         )
         tokens_dev = sample_tokens(
             logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks

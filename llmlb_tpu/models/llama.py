@@ -318,7 +318,7 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
 
 
 def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
-                 *, stacked_names=None, mlp_fn=_default_mlp_fn):
+                 *, stacked_names=None, mlp_fn=_default_mlp_fn, window=None):
     """Shared one-token decode body for every model family.
 
     The layer loop is UNROLLED (static layer indices) rather than a
@@ -354,7 +354,8 @@ def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
                 v[:, 0].astype(cache_v.dtype)
             )
             return gqa_attention_decode(
-                q, cache_k[layer_idx], cache_v[layer_idx], write_pos + 1
+                q, cache_k[layer_idx], cache_v[layer_idx], write_pos + 1,
+                window=window,
             )
 
         x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn)
@@ -578,7 +579,7 @@ def make_context_parallel_prefill(cfg: LlamaConfig, mesh: Mesh):
     return fn
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"),
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
          donate_argnames=("cache_k", "cache_v"))
 def decode_step(
     params: Params,
@@ -588,6 +589,8 @@ def decode_step(
     cache_k: jnp.ndarray,  # [L, B, S, K, D]
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
+    window: int | None = None,  # static context-window bucket (≥ max seq+1)
 ):
     """One decode step across all slots. Returns (logits [B, V] fp32, caches)."""
-    return _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v)
+    return _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
+                        window=window)

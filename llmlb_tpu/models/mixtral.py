@@ -213,10 +213,10 @@ def prefill_extend_slots(params, cfg: MixtralConfig, input_ids, chunk_lens,
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"),
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
          donate_argnames=("cache_k", "cache_v"))
 def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_v,
-                mesh: Mesh | None = None):
+                mesh: Mesh | None = None, window: int | None = None):
     """One decode step across all slots. Same contract as llama.decode_step.
 
     Decode is ALWAYS exact MoE: capacity drops here would make a request's
@@ -224,4 +224,5 @@ def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_
     return _decode_impl(
         params, cfg, input_ids, seq_lens, cache_k, cache_v,
         stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
+        window=window,
     )

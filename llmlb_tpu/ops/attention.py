@@ -132,9 +132,31 @@ def gqa_attention_decode(
     k_cache: jnp.ndarray,  # [B, S, K, D] — slot-capacity cache incl. current token
     v_cache: jnp.ndarray,  # [B, S, K, D]
     kv_lens: jnp.ndarray,  # [B] int32 — valid cache length per slot (incl. current)
+    window: int | None = None,  # static: read only the first `window` cells
 ) -> jnp.ndarray:
-    """One-token decode attention against the full slot cache. Returns [B, 1, H, D]."""
-    if _pallas_enabled():
+    """One-token decode attention against the slot cache. Returns [B, 1, H, D].
+
+    `window` (STATIC) bounds how much of the capacity axis is read: the
+    scheduler picks the smallest bucket covering every active sequence, so
+    attention HBM traffic scales with the context actually in use instead of
+    the full slot capacity (reading 2048 cells for 300-token contexts wasted
+    ~85% of decode's cache bandwidth). Rows with kv_lens <= window are
+    exact; rows with kv_lens > window (parked chunked-prefill / freed slots,
+    whose device counters sit at capacity) produce garbage the caller must
+    discard — the engine's emission loop skips exactly those rows."""
+    s = k_cache.shape[1]
+    if window is not None and window < s:
+        if _pallas_enabled():
+            from llmlb_tpu.ops.pallas_attention import flash_decode
+
+            # the kernel bounds its grid instead of slicing (no copy)
+            return flash_decode(
+                q[:, 0], k_cache, v_cache, kv_lens, window=window
+            )[:, None]
+        k_cache = jax.lax.slice_in_dim(k_cache, 0, window, axis=1)
+        v_cache = jax.lax.slice_in_dim(v_cache, 0, window, axis=1)
+        s = window
+    elif _pallas_enabled():
         from llmlb_tpu.ops.pallas_attention import flash_decode
 
         return flash_decode(q[:, 0], k_cache, v_cache, kv_lens)[:, None]
@@ -147,7 +169,6 @@ def gqa_attention_decode(
         "btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32
     ) * scale  # [B, K, G, 1, S]
 
-    s = k_cache.shape[1]
     valid = jnp.arange(s, dtype=jnp.int32)[None, :] < kv_lens[:, None]  # [B, S]
     scores = jnp.where(valid[:, None, None, None, :], scores, _NEG_INF)
 

@@ -122,7 +122,9 @@ def _decode_kernel(
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_k", "interpret", "window")
+)
 def flash_decode(
     q: jnp.ndarray,  # [B, H, D]
     k_cache: jnp.ndarray,  # [B, S, K, D]
@@ -131,8 +133,18 @@ def flash_decode(
     *,
     block_k: int = 128,
     interpret: bool | None = None,
+    window: int | None = None,  # static: sweep only the first `window` cells
 ) -> jnp.ndarray:
-    """Ragged one-token GQA decode attention. Returns [B, H, D] in q.dtype."""
+    """Ragged one-token GQA decode attention. Returns [B, H, D] in q.dtype.
+
+    `window` bounds the kv-block sweep (grid), NOT the input shapes — the
+    kernel simply never DMAs cache blocks past it, so short contexts in a
+    large-capacity cache cost only the traffic they actually need and no
+    slice copy is materialized. Contract: rows with kv_lens <= window are
+    exact; rows with kv_lens > window produce GARBAGE (their mask believes
+    unswept cells are valid) and the caller must discard them — the engine
+    does this for parked/freed slot rows, whose device counters sit at
+    capacity while the scheduler picks the window from active rows only."""
     if interpret is None:
         interpret = _interpret_default()
     b, h, d = q.shape
@@ -140,7 +152,8 @@ def flash_decode(
     num_kv = k_cache.shape[2]
     g = h // num_kv
     blk = min(block_k, s)
-    num_blocks = pl.cdiv(s, blk)
+    sweep = s if window is None else max(blk, min(window, s))
+    num_blocks = pl.cdiv(sweep, blk)
     qg = q.reshape(b, num_kv, g, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

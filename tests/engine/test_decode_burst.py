@@ -238,3 +238,32 @@ def test_prefill_dispatch_failure_reaches_batched_requests(cfg):
             assert kind == "error", (kind, val)
     finally:
         core.stop()
+
+
+def test_window_buckets_cross_boundary(cfg):
+    """Generation that crosses a context-window bucket boundary (256) must
+    be identical to a run with only the full-capacity window available."""
+    import dataclasses as _dc
+
+    cfg600 = _dc.replace(cfg, max_position_embeddings=1024)
+    prompt = [7] * 250  # window 256 covers prefill; generation crosses it
+
+    core_full = EngineCore(cfg600, num_slots=2, slot_capacity=600,
+                           prefill_buckets=(256,), seed=0, decode_burst=4)
+    core_full._window_buckets = (600,)  # capacity only: no windowing
+    core_full.start()
+    try:
+        base = _run_greedy(core_full, [prompt], max_tokens=20)
+    finally:
+        core_full.stop()
+
+    core_win = EngineCore(cfg600, num_slots=2, slot_capacity=600,
+                          prefill_buckets=(256,), seed=0, decode_burst=4)
+    assert core_win._window_buckets == (256, 512, 600)
+    core_win.start()
+    try:
+        windowed = _run_greedy(core_win, [prompt], max_tokens=20)
+    finally:
+        core_win.stop()
+
+    assert windowed == base

@@ -187,3 +187,28 @@ def test_flash_extend_matches_xla_extend():
             np.asarray(out)[bi, :n], np.asarray(ref)[bi, :n],
             rtol=2e-5, atol=2e-5,
         )
+
+
+def test_flash_decode_window_bounds_sweep():
+    """A static window >= max(kv_lens) must be a numeric no-op while sweeping
+    fewer kv blocks (the scheduler's context-window bucket optimization)."""
+    b, h, kv, d, s = 2, 8, 4, 32, 128
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = _rand(keys[0], (b, 1, h, d))
+    k_cache = _rand(keys[1], (b, s, kv, d))
+    v_cache = _rand(keys[2], (b, s, kv, d))
+    kv_lens = jnp.array([40, 64], jnp.int32)  # all within window=64
+
+    full = flash_decode(q[:, 0], k_cache, v_cache, kv_lens,
+                        block_k=32, interpret=True)
+    windowed = flash_decode(q[:, 0], k_cache, v_cache, kv_lens,
+                            block_k=32, interpret=True, window=64)
+    np.testing.assert_allclose(windowed, full, rtol=2e-5, atol=2e-5)
+
+    # the XLA dispatch path with a window must also match
+    xla_windowed = gqa_attention_decode(
+        q, k_cache, v_cache, kv_lens, window=64
+    )
+    np.testing.assert_allclose(
+        xla_windowed[:, 0], full, rtol=2e-5, atol=2e-5
+    )
