@@ -302,7 +302,7 @@ class EngineCore:
             target=self._loop, name="engine-step-loop", daemon=True
         )
         self._thread.start()
-        if self.decode_burst > 1 and len(self._window_buckets) > 1:
+        if len(self._window_buckets) > 1:
             # Pre-compile every window-bucket variant off-thread: the first
             # sequence to cross a bucket boundary must not stall every
             # in-flight stream behind a multi-second XLA compile.
@@ -312,29 +312,41 @@ class EngineCore:
             ).start()
 
     def _prewarm_windows(self) -> None:
-        import jax.numpy as _jnp
-
         def shape_of(x):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            # Shardings are part of jax's executable cache key: a prewarm
+            # lowered without them compiles a different (unsharded) variant
+            # and the real dispatch would still stall on a fresh compile.
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
 
+        param_shapes = {k: shape_of(v) for k, v in self.params.items()}
+        args = (
+            param_shapes,
+            shape_of(self._d_last_tokens),
+            shape_of(self._d_seq_lens),
+            shape_of(self.cache_k), shape_of(self.cache_v),
+            shape_of(self._d_temps), shape_of(self._d_top_ps),
+            shape_of(self._d_top_ks),
+            shape_of(self._key),  # split keys keep this shape/dtype
+        )
         for w in self._window_buckets:
             if not self._running:
                 return
             try:
-                fn = self._decode_many.get(w)
-                if fn is None:
-                    fn = self._build_decode_many(self.decode_burst, w)
-                    self._decode_many[w] = fn
-                fn.lower(
-                    {k: shape_of(v) for k, v in self.params.items()},
-                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
-                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
-                    shape_of(self.cache_k), shape_of(self.cache_v),
-                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.float32),
-                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.float32),
-                    jax.ShapeDtypeStruct((self.num_slots,), _jnp.int32),
-                    shape_of(self._key),  # split keys keep this shape/dtype
-                ).compile()
+                if self.decode_burst > 1:
+                    fn = self._decode_many.get(w)
+                    if fn is None:
+                        fn = self._build_decode_many(self.decode_burst, w)
+                        self._decode_many[w] = fn
+                    fn.lower(*args).compile()
+                else:
+                    # single-step mode compiles decode_step per window too
+                    self.family.decode_step.lower(
+                        param_shapes, self.cfg, shape_of(self._d_last_tokens),
+                        shape_of(self._d_seq_lens), shape_of(self.cache_k),
+                        shape_of(self.cache_v), self.mesh, window=w,
+                    ).compile()
             except Exception:  # pragma: no cover - best-effort warmup
                 log.exception("window %d prewarm failed (will compile "
                               "on first use)", w)

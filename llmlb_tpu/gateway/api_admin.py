@@ -95,6 +95,25 @@ async def get_endpoint(request: web.Request) -> web.Response:
     return web.json_response(endpoint_to_json(ep, state.registry.models_for(ep.id)))
 
 
+async def get_endpoint_system_info(request: web.Request) -> web.Response:
+    """Live device/system probe of one endpoint's runtime (reference
+    system_info/mod.rs dispatch; llama.cpp /slots + /metrics, TPU
+    /api/health, Ollama /api/version + /api/ps, xLLM /api/system)."""
+    from llmlb_tpu.gateway.system_info import get_endpoint_system_info as probe
+
+    state = request.app["state"]
+    ep = state.registry.get(request.match_info["endpoint_id"])
+    if ep is None:
+        return _json_error(404, "endpoint not found")
+    info = await probe(ep, state.http)
+    return web.json_response({
+        "endpoint_id": ep.id,
+        "endpoint_type": ep.endpoint_type.value,
+        "available": info is not None,
+        "info": info,
+    })
+
+
 async def create_endpoint(request: web.Request) -> web.Response:
     state = request.app["state"]
     try:

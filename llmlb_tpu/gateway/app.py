@@ -330,6 +330,10 @@ def create_app(state: AppState) -> web.Application:
     r.add_get("/api/endpoints", api_admin.list_endpoints)
     r.add_post("/api/endpoints", api_admin.create_endpoint)
     r.add_get("/api/endpoints/{endpoint_id}", api_admin.get_endpoint)
+    r.add_get(
+        "/api/endpoints/{endpoint_id}/system-info",
+        api_admin.get_endpoint_system_info,
+    )
     r.add_put("/api/endpoints/{endpoint_id}", api_admin.update_endpoint)
     r.add_delete("/api/endpoints/{endpoint_id}", api_admin.delete_endpoint)
     r.add_post("/api/endpoints/{endpoint_id}/test", api_admin.test_endpoint)

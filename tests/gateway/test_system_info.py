@@ -1,0 +1,158 @@
+"""Per-endpoint device probes (reference system_info/mod.rs dispatch,
+llamacpp.rs /slots + /metrics strategies) surfaced at
+GET /api/endpoints/{id}/system-info."""
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.gateway.types import EndpointType
+
+
+class MockLlamaCpp:
+    def __init__(self, with_slots=True):
+        self.with_slots = with_slots
+        self.server: TestServer | None = None
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def start(self):
+        app = web.Application()
+        if self.with_slots:
+            app.router.add_get("/slots", self._slots)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/v1/models", self._models)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def stop(self):
+        await self.server.close()
+
+    async def _slots(self, request):
+        return web.json_response([
+            {"id": 0, "n_ctx": 8192, "is_processing": True},
+            {"id": 1, "n_ctx": 8192, "is_processing": False},
+        ])
+
+    async def _metrics(self, request):
+        return web.Response(
+            text="llamacpp:kv_cache_tokens 1234\nother 1\n",
+            content_type="text/plain",
+        )
+
+    async def _models(self, request):
+        return web.json_response({"data": [{"id": "m"}]})
+
+
+class MockOllamaRuntime:
+    def __init__(self):
+        self.server: TestServer | None = None
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.port}"
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/api/version", lambda r: web.json_response(
+            {"version": "0.5.1"}))
+        app.router.add_get("/api/ps", lambda r: web.json_response({
+            "models": [
+                {"name": "llama3:8b", "size_vram": 5_000_000_000},
+                {"name": "qwen2.5:0.5b", "size_vram": 500_000_000},
+            ],
+        }))
+        app.router.add_get("/v1/models", lambda r: web.json_response(
+            {"data": [{"id": "llama3:8b"}]}))
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def stop(self):
+        await self.server.close()
+
+
+@pytest.mark.asyncio
+async def test_llama_cpp_slots_probe():
+    from tests.support import GatewayHarness
+
+    gw = await GatewayHarness.create()
+    mock = await MockLlamaCpp().start()
+    try:
+        gw.register_mock(mock.url, ["m"], endpoint_type=EndpointType.LLAMA_CPP)
+        eid = gw.state.registry.list_all()[0].id
+        headers = await gw.admin_headers()
+        resp = await gw.client.get(
+            f"/api/endpoints/{eid}/system-info", headers=headers
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["available"] is True
+        assert body["info"]["device"] == "llama.cpp"
+        assert body["info"]["parallel_slots"] == 2
+        assert body["info"]["n_ctx"] == 8192
+        assert body["info"]["busy_slots"] == 1
+        assert body["info"]["source"] == "slots"
+    finally:
+        await mock.stop()
+        await gw.close()
+
+
+@pytest.mark.asyncio
+async def test_llama_cpp_metrics_fallback():
+    from tests.support import GatewayHarness
+
+    gw = await GatewayHarness.create()
+    mock = await MockLlamaCpp(with_slots=False).start()
+    try:
+        gw.register_mock(mock.url, ["m"], endpoint_type=EndpointType.LLAMA_CPP)
+        eid = gw.state.registry.list_all()[0].id
+        headers = await gw.admin_headers()
+        body = await (await gw.client.get(
+            f"/api/endpoints/{eid}/system-info", headers=headers
+        )).json()
+        assert body["info"]["source"] == "metrics"
+        assert body["info"]["kv_cache_tokens"] == 1234
+    finally:
+        await mock.stop()
+        await gw.close()
+
+
+@pytest.mark.asyncio
+async def test_ollama_probe_and_unsupported_type():
+    from tests.support import GatewayHarness
+
+    gw = await GatewayHarness.create()
+    mock = await MockOllamaRuntime().start()
+    try:
+        gw.register_mock(
+            mock.url, ["llama3:8b"], endpoint_type=EndpointType.OLLAMA
+        )
+        gw.register_mock(
+            "http://127.0.0.1:9", ["x"],
+            endpoint_type=EndpointType.OPENAI_COMPATIBLE,
+        )
+        headers = await gw.admin_headers()
+        eps = {e.endpoint_type: e for e in gw.state.registry.list_all()}
+
+        body = await (await gw.client.get(
+            f"/api/endpoints/{eps[EndpointType.OLLAMA].id}/system-info",
+            headers=headers,
+        )).json()
+        assert body["info"]["version"] == "0.5.1"
+        assert body["info"]["loaded_models"] == ["llama3:8b", "qwen2.5:0.5b"]
+        assert body["info"]["vram_bytes"] == 5_500_000_000
+
+        # generic OpenAI-compatible runtimes expose nothing probeable
+        body = await (await gw.client.get(
+            f"/api/endpoints/{eps[EndpointType.OPENAI_COMPATIBLE].id}"
+            "/system-info",
+            headers=headers,
+        )).json()
+        assert body["available"] is False and body["info"] is None
+    finally:
+        await mock.stop()
+        await gw.close()
