@@ -312,23 +312,28 @@ class EngineCore:
             ).start()
 
     def _prewarm_windows(self) -> None:
-        def shape_of(x):
+        def sharded(x):
             # Shardings are part of jax's executable cache key: a prewarm
             # lowered without them compiles a different (unsharded) variant
             # and the real dispatch would still stall on a fresh compile.
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
-            )
+            # Only the explicitly device_put arrays (params, caches) carry
+            # one — the uncommitted scalar vectors must stay unspecified, or
+            # their incidental single-device placement conflicts with the
+            # mesh sharding at lowering time.
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
 
-        param_shapes = {k: shape_of(v) for k, v in self.params.items()}
+        def plain(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        param_shapes = {k: sharded(v) for k, v in self.params.items()}
         args = (
             param_shapes,
-            shape_of(self._d_last_tokens),
-            shape_of(self._d_seq_lens),
-            shape_of(self.cache_k), shape_of(self.cache_v),
-            shape_of(self._d_temps), shape_of(self._d_top_ps),
-            shape_of(self._d_top_ks),
-            shape_of(self._key),  # split keys keep this shape/dtype
+            plain(self._d_last_tokens),
+            plain(self._d_seq_lens),
+            sharded(self.cache_k), sharded(self.cache_v),
+            plain(self._d_temps), plain(self._d_top_ps),
+            plain(self._d_top_ks),
+            plain(self._key),  # split keys keep this shape/dtype
         )
         for w in self._window_buckets:
             if not self._running:
@@ -343,9 +348,9 @@ class EngineCore:
                 else:
                     # single-step mode compiles decode_step per window too
                     self.family.decode_step.lower(
-                        param_shapes, self.cfg, shape_of(self._d_last_tokens),
-                        shape_of(self._d_seq_lens), shape_of(self.cache_k),
-                        shape_of(self.cache_v), self.mesh, window=w,
+                        param_shapes, self.cfg, plain(self._d_last_tokens),
+                        plain(self._d_seq_lens), sharded(self.cache_k),
+                        sharded(self.cache_v), self.mesh, window=w,
                     ).compile()
             except Exception:  # pragma: no cover - best-effort warmup
                 log.exception("window %d prewarm failed (will compile "

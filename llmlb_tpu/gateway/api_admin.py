@@ -522,6 +522,13 @@ async def update_setting(request: web.Request) -> web.Response:
         return _json_error(400, "body must have 'key' and 'value'")
     if key.startswith("auth."):
         return _json_error(400, "auth.* settings are not writable via API")
+    if key == "ip_alert_threshold":
+        from llmlb_tpu.gateway.api_dashboard import parse_ip_alert_threshold
+
+        try:
+            parse_ip_alert_threshold(value)
+        except ValueError:
+            return _json_error(400, "ip_alert_threshold must be an integer >= 1")
     state.db.set_setting(key, value)
     return web.json_response({"key": key, "value": value})
 

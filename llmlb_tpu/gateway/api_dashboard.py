@@ -12,10 +12,34 @@ import asyncio
 import datetime
 import json
 import logging
+import time
 
 from aiohttp import WSMsgType, web
 
 from llmlb_tpu.gateway.auth import AuthError, verify_jwt
+
+IP_ALERT_THRESHOLD_DEFAULT = 100  # parity: dashboard.rs:1350
+
+
+def parse_ip_alert_threshold(value: str) -> int:
+    """Integer >= 1, or ValueError (dashboard.rs:1353-1364)."""
+    parsed = int(value)  # raises ValueError on non-integers
+    if parsed < 1:
+        raise ValueError("ip_alert_threshold must be an integer >= 1")
+    return parsed
+
+
+def effective_ip_alert_threshold(raw: str | None) -> int:
+    """Configured threshold with default fallback; a corrupt stored value
+    logs and falls back rather than breaking analytics (dashboard.rs:1367)."""
+    if raw is None:
+        return IP_ALERT_THRESHOLD_DEFAULT
+    try:
+        return parse_ip_alert_threshold(raw)
+    except ValueError:
+        log.warning("invalid ip_alert_threshold %r in settings; using "
+                    "default %d", raw, IP_ALERT_THRESHOLD_DEFAULT)
+        return IP_ALERT_THRESHOLD_DEFAULT
 
 log = logging.getLogger("llmlb_tpu.gateway.dashboard")
 
@@ -174,6 +198,20 @@ async def client_analytics(request: web.Request) -> web.Response:
            GROUP BY client_ip ORDER BY requests DESC LIMIT 50""",
         (since_ts,),
     )
+    # is_alert: last-HOUR request count at/above the configurable threshold
+    # (settings key ip_alert_threshold, default 100 — dashboard.rs:1265-1279)
+    threshold = effective_ip_alert_threshold(
+        state.db.get_setting("ip_alert_threshold")
+    )
+    hour_ago = time.time() - 3600.0
+    last_hour = {
+        row["client_ip"]: row["n"]
+        for row in state.db.query(
+            """SELECT client_ip, COUNT(*) AS n FROM request_history
+               WHERE ts>=? AND client_ip IS NOT NULL GROUP BY client_ip""",
+            (hour_ago,),
+        )
+    }
     heatmap = state.db.query(
         """SELECT CAST(strftime('%w', ts, 'unixepoch') AS INTEGER) AS dow,
                   CAST(strftime('%H', ts, 'unixepoch') AS INTEGER) AS hour,
@@ -189,10 +227,16 @@ async def client_analytics(request: web.Request) -> web.Response:
            GROUP BY api_key_id ORDER BY requests DESC LIMIT 50""",
         (since_ts,),
     )
+    ranking_out = []
+    for r in ranking:
+        row = dict(r)
+        row["is_alert"] = last_hour.get(row["client_ip"], 0) >= threshold
+        ranking_out.append(row)
     return web.json_response({
-        "ranking": [dict(r) for r in ranking],
+        "ranking": ranking_out,
         "heatmap": [dict(r) for r in heatmap],
         "by_api_key": [dict(r) for r in by_key],
+        "ip_alert_threshold": threshold,
     })
 
 

@@ -105,8 +105,10 @@ async def _tpu_info(ep: Endpoint, session, headers) -> dict | None:
 
 
 async def _ollama_info(ep: Endpoint, session, headers) -> dict | None:
-    version = await _get_json(session, ep.url + "/api/version", headers)
-    ps = await _get_json(session, ep.url + "/api/ps", headers)
+    version, ps = await asyncio.gather(
+        _get_json(session, ep.url + "/api/version", headers),
+        _get_json(session, ep.url + "/api/ps", headers),
+    )
     if version is None and ps is None:
         return None
     loaded = []
@@ -121,7 +123,9 @@ async def _ollama_info(ep: Endpoint, session, headers) -> dict | None:
         "version": (version or {}).get("version")
         if isinstance(version, dict) else None,
         "loaded_models": loaded,
-        "vram_bytes": vram or None,
+        # 0 with models loaded means "CPU-resident", which is a real state;
+        # None means /api/ps gave us nothing to measure
+        "vram_bytes": vram if loaded else None,
         "source": "api_version+ps",
     }
 

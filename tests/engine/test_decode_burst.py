@@ -267,3 +267,33 @@ def test_window_buckets_cross_boundary(cfg):
         core_win.stop()
 
     assert windowed == base
+
+
+def test_prewarm_compiles_both_modes(cfg):
+    """Prewarm must cover burst AND single-step modes (the k==1 path gained
+    per-window static recompiles of decode_step); a signature drift between
+    decode_step and the prewarm lowering would otherwise be swallowed by the
+    best-effort except and only surface as production compile stalls."""
+    import dataclasses as _dc
+
+    from unittest import mock
+
+    from llmlb_tpu.engine import scheduler as sched_mod
+
+    cfg512 = _dc.replace(cfg, max_position_embeddings=1024)
+    for burst in (4, 1):
+        core = EngineCore(cfg512, num_slots=2, slot_capacity=512,
+                          prefill_buckets=(16,), seed=0, decode_burst=burst)
+        assert core._window_buckets == (256, 512)
+        core._running = True
+        try:
+            # prewarm swallows failures by design (best-effort in prod);
+            # here any swallowed lowering error must fail the test
+            with mock.patch.object(sched_mod.log, "exception",
+                                   side_effect=AssertionError) as logged:
+                core._prewarm_windows()
+            assert not logged.called
+            if burst > 1:
+                assert sorted(core._decode_many) == [256, 512]
+        finally:
+            core._running = False

@@ -319,3 +319,59 @@ def test_sanitizer_edge_cases():
     parsed = _json.loads(out)
     assert parsed["_truncated"] is True
     assert parsed["_original_bytes"] > MAX_STORED_BODY_BYTES
+
+
+async def test_client_ip_alert_threshold():
+    """Client analytics flags IPs whose last-hour request count reaches the
+    configurable ip_alert_threshold; invalid threshold writes are rejected
+    (reference clients_alert_test T052/T053, dashboard.rs:1265-1379)."""
+    import time as _time
+    import uuid as _uuid
+
+    from tests.support import GatewayHarness
+
+    gw = await GatewayHarness.create()
+    try:
+        admin = await gw.admin_headers()
+        # default threshold is 100
+        resp = await gw.client.get("/api/dashboard/clients", headers=admin)
+        assert (await resp.json())["ip_alert_threshold"] == 100
+
+        # invalid writes are 400; valid writes apply
+        for bad in ("0", "-3", "abc"):
+            resp = await gw.client.put(
+                "/api/dashboard/settings",
+                json={"key": "ip_alert_threshold", "value": bad},
+                headers=admin,
+            )
+            assert resp.status == 400, bad
+        resp = await gw.client.put(
+            "/api/dashboard/settings",
+            json={"key": "ip_alert_threshold", "value": "5"},
+            headers=admin,
+        )
+        assert resp.status == 200
+
+        # IP-A: 10 requests in the last hour (over); IP-B: 2 (under);
+        # IP-C: exactly 5 (at threshold -> alert, >= semantics)
+        now = _time.time()
+        for ip, n in (("10.0.0.1", 10), ("10.0.0.2", 2), ("10.0.0.3", 5)):
+            for i in range(n):
+                gw.state.db.execute(
+                    """INSERT INTO request_history
+                       (id, ts, model, api_kind, path, status_code,
+                        duration_ms, prompt_tokens, completion_tokens,
+                        client_ip, stream)
+                       VALUES (?,?,?,?,?,?,?,?,?,?,0)""",
+                    (_uuid.uuid4().hex, now - 60 * i, "m", "chat", "/x",
+                     200, 1.0, 1, 1, ip),
+                )
+        resp = await gw.client.get("/api/dashboard/clients", headers=admin)
+        body = await resp.json()
+        flags = {r["client_ip"]: r["is_alert"] for r in body["ranking"]}
+        assert flags["10.0.0.1"] is True
+        assert flags["10.0.0.2"] is False
+        assert flags["10.0.0.3"] is True  # >= threshold
+        assert body["ip_alert_threshold"] == 5
+    finally:
+        await gw.close()
