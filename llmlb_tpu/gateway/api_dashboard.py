@@ -186,7 +186,12 @@ async def client_analytics(request: web.Request) -> web.Response:
     """Client-IP rankings / timeline / per-client detail (dashboard.rs analytics)."""
     state = request.app["state"]
     q = request.query
-    days = min(int(q.get("days", 7)), 90)
+    try:
+        days = min(int(q.get("days", 7)), 90)
+    except ValueError:
+        return web.json_response(
+            {"error": "days must be an integer"}, status=400
+        )
     since_ts = (
         datetime.datetime.now() - datetime.timedelta(days=days)
     ).timestamp()

@@ -151,6 +151,7 @@ const routes = {
   endpoints: views.endpoints,
   requests: views.requests,
   tokens: views.tokens,
+  clients: views.clients,
   playground: views.playground,
   audit: views.audit,
   access: views.access,

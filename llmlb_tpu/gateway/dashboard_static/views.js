@@ -161,6 +161,7 @@ export async function endpoints(view) {
         <td>
           <button data-act="test">test</button>
           <button data-act="sync">sync</button>
+          <button data-act="info">info</button>
           <button data-act="del" class="danger">remove</button>
         </td></tr>`);
       row.querySelector('[data-act="test"]').addEventListener("click", async () => {
@@ -175,6 +176,17 @@ export async function endpoints(view) {
           const r = await api(`/api/endpoints/${ep.id}/sync`, { method: "POST" });
           toast(`Synced: +${r.added} −${r.removed}`);
           refresh();
+        } catch (e) { toast(e.message, true); }
+      });
+      row.querySelector('[data-act="info"]').addEventListener("click", async () => {
+        try {
+          const r = await api(`/api/endpoints/${ep.id}/system-info`);
+          if (!r.available) { toast("No device info exposed by this runtime"); return; }
+          const detail = h(`<tr class="detail-row"><td colspan="7">
+            <pre class="mono">${esc(JSON.stringify(r.info, null, 2))}</pre></td></tr>`);
+          const old = tbody.querySelector(".detail-row");
+          if (old) old.remove();
+          row.after(detail);
         } catch (e) { toast(e.message, true); }
       });
       row.querySelector('[data-act="del"]').addEventListener("click", async () => {
@@ -294,6 +306,62 @@ export async function tokens(view) {
     ? `<table><thead><tr><th>model</th><th>requests</th><th>prompt tokens</th>
        <th>completion tokens</th></tr></thead><tbody>${rows}</tbody></table>`
     : `<p class="muted">No data yet.</p>`;
+}
+
+// ------------------------------------------------------------------- clients
+
+export async function clients(view) {
+  view.appendChild(h(`<h1>Clients</h1>`));
+  const controls = h(`<div class="formrow">
+    <label>Alert threshold (req/hour)
+      <input id="cl-threshold" size="6"></label>
+    <button class="primary" id="cl-save">Save</button>
+  </div>`);
+  view.appendChild(controls);
+  const rankBox = document.createElement("div");
+  const keyBox = document.createElement("div");
+  view.appendChild(rankBox);
+  view.appendChild(h(`<h2>By API key (7 days)</h2>`));
+  view.appendChild(keyBox);
+
+  async function refresh() {
+    const body = await api("/api/dashboard/clients?days=7");
+    controls.querySelector("#cl-threshold").value = body.ip_alert_threshold;
+    const rows = (body.ranking || []).map((r) => `
+      <tr>
+        <td class="mono">${esc(r.client_ip)}
+          ${r.is_alert
+            ? `<span class="badge"><span class="dot offline"></span>alert</span>`
+            : ""}</td>
+        <td>${fmtNum(r.requests)}</td>
+        <td>${fmtNum(r.errors || 0)}</td>
+        <td>${fmtNum(r.pt || 0)} / ${fmtNum(r.ct || 0)}</td></tr>`).join("");
+    rankBox.innerHTML = rows
+      ? `<table><thead><tr><th>client ip</th><th>requests</th><th>errors</th>
+         <th>tokens in/out</th></tr></thead><tbody>${rows}</tbody></table>`
+      : `<p class="muted">No client traffic recorded.</p>`;
+    const keyRows = (body.by_api_key || []).map((r) => `
+      <tr><td class="mono">${esc(r.api_key_id)}</td>
+      <td>${fmtNum(r.requests)}</td><td>${fmtNum(r.ct || 0)}</td></tr>`).join("");
+    keyBox.innerHTML = keyRows
+      ? `<table><thead><tr><th>api key</th><th>requests</th>
+         <th>completion tokens</th></tr></thead><tbody>${keyRows}</tbody></table>`
+      : `<p class="muted">No API-key traffic.</p>`;
+  }
+
+  controls.querySelector("#cl-save").addEventListener("click", async () => {
+    try {
+      await api("/api/dashboard/settings", {
+        method: "PUT",
+        body: { key: "ip_alert_threshold",
+                value: controls.querySelector("#cl-threshold").value.trim() },
+      });
+      toast("Threshold saved");
+      refresh();
+    } catch (e) { toast(e.message, true); }
+  });
+
+  await refresh();
 }
 
 // ---------------------------------------------------------------- playground

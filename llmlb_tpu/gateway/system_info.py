@@ -113,19 +113,22 @@ async def _ollama_info(ep: Endpoint, session, headers) -> dict | None:
         return None
     loaded = []
     vram = 0
+    vram_known = False
     models = (ps or {}).get("models") if isinstance(ps, dict) else None
     for m in models or []:
         if isinstance(m, dict):
             loaded.append(m.get("name"))
-            vram += m.get("size_vram") or 0
+            if "size_vram" in m:
+                vram_known = True
+                vram += m.get("size_vram") or 0
     return {
         "device": "ollama",
         "version": (version or {}).get("version")
         if isinstance(version, dict) else None,
         "loaded_models": loaded,
-        # 0 with models loaded means "CPU-resident", which is a real state;
-        # None means /api/ps gave us nothing to measure
-        "vram_bytes": vram if loaded else None,
+        # 0 with the field present means "CPU-resident" (a real state);
+        # None means the runtime never reported VRAM at all
+        "vram_bytes": vram if vram_known else None,
         "source": "api_version+ps",
     }
 
