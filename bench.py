@@ -147,12 +147,17 @@ def run_engine_bench(platform: str) -> dict:
         buckets = (128, 256, 512)
         prompt_len, warm_tokens, max_tokens = 128, 16, 512
         measure_s = 10.0
+        # Burst 16: with ~93 ms of host readback latency per fetch through
+        # the tunnel and single-digit-ms decode steps, k=16 keeps the sync
+        # under ~40% of the burst. Operators tune via the same env knob.
+        burst = int(os.environ.get("LLMLB_DECODE_BURST", "16"))
     else:
         preset = "debug-tiny"
         num_slots, capacity = 4, 128
         buckets = (16, 32)
         prompt_len, warm_tokens, max_tokens = 16, 4, 96
         measure_s = 3.0
+        burst = 1
 
     cfg = get_preset(preset)
     devices = jax.devices()
@@ -163,7 +168,7 @@ def run_engine_bench(platform: str) -> dict:
     t0 = time.perf_counter()
     core = EngineCore(
         cfg, num_slots=num_slots, slot_capacity=capacity,
-        prefill_buckets=buckets, seed=0,
+        prefill_buckets=buckets, seed=0, decode_burst=burst,
     )
     core.start()
     log(f"engine up in {time.perf_counter() - t0:.1f}s "
@@ -271,6 +276,7 @@ def run_engine_bench(platform: str) -> dict:
         "n_chips": n_chips,
         "model": preset,
         "batch_slots": num_slots,
+        "decode_burst": burst,
         "ttft_p50_ms": round(ttft_p50_ms, 1),
         "ttft_p99_ms": round(ttft_p99_ms, 1),
         "long_prompt_tokens": long_len if long_ttft_ms is not None else None,

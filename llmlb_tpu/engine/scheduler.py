@@ -26,7 +26,7 @@ import threading
 import time
 import uuid
 from functools import partial
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -268,6 +268,11 @@ class EngineCore:
                 decode_burst = 8 if jax.default_backend() == "tpu" else 1
         self.decode_burst = max(1, int(decode_burst))
         self._decode_many: dict[int, Callable] = {}  # per context window
+        # get-or-build under a lock: the prewarm thread and the step loop
+        # must share ONE jit wrapper per window (two wrappers for the same
+        # signature would compile twice; one wrapper lets jax's internal
+        # compile lock dedup concurrent callers)
+        self._decode_many_lock = threading.Lock()
 
         # Context-window buckets (pow2, up to capacity): every decode reads
         # only the smallest bucket covering all active sequences, so
@@ -340,11 +345,7 @@ class EngineCore:
                 return
             try:
                 if self.decode_burst > 1:
-                    fn = self._decode_many.get(w)
-                    if fn is None:
-                        fn = self._build_decode_many(self.decode_burst, w)
-                        self._decode_many[w] = fn
-                    fn.lower(*args).compile()
+                    self._decode_many_for(w).lower(*args).compile()
                 else:
                     # single-step mode compiles decode_step per window too
                     self.family.decode_step.lower(
@@ -855,6 +856,14 @@ class EngineCore:
 
         return jax.jit(many, donate_argnums=(3, 4))
 
+    def _decode_many_for(self, window: int) -> Callable:
+        with self._decode_many_lock:
+            fn = self._decode_many.get(window)
+            if fn is None:
+                fn = self._build_decode_many(self.decode_burst, window)
+                self._decode_many[window] = fn
+            return fn
+
     def _decode_active(self) -> bool:
         active = [
             i for i, s in enumerate(self.slots)
@@ -868,10 +877,8 @@ class EngineCore:
         if k > 1:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
-            if window not in self._decode_many:
-                self._decode_many[window] = self._build_decode_many(k, window)
             (self._d_last_tokens, self._d_seq_lens, self.cache_k,
-             self.cache_v, toks_dev) = self._decode_many[window](
+             self.cache_v, toks_dev) = self._decode_many_for(window)(
                 self.params, self._d_last_tokens, self._d_seq_lens,
                 self.cache_k, self.cache_v,
                 self._d_temps, self._d_top_ps, self._d_top_ks, sk,

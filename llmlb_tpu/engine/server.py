@@ -695,6 +695,11 @@ def main(argv: list[str] | None = None) -> None:
         help="comma-separated one-shot prefill lengths (default 32..512); "
              "prompts beyond the largest run through chunked prefill",
     )
+    parser.add_argument(
+        "--decode-burst", type=int, default=None,
+        help="decode+sample steps fused per device dispatch (default: "
+             "8 on TPU, 1 elsewhere; also via LLMLB_DECODE_BURST)",
+    )
     # modality services (checkpoint dir, or "random" for test weights)
     parser.add_argument("--asr", default=None,
                         help="whisper checkpoint dir or 'random'")
@@ -717,6 +722,8 @@ def main(argv: list[str] | None = None) -> None:
         if not buckets:
             parser.error("--prefill-buckets must name at least one length")
         extra["prefill_buckets"] = buckets
+    if args.decode_burst is not None:
+        extra["decode_burst"] = max(1, args.decode_burst)
 
     logging.basicConfig(level=logging.INFO)
     # Multi-host bring-up must precede the first jax backend use (engine

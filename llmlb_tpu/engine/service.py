@@ -13,7 +13,6 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import AsyncIterator
 
-import jax
 
 from llmlb_tpu.engine.presets import get_preset
 from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams

@@ -9,13 +9,12 @@ mostly served from in-memory caches (registry, TPS tracker) seeded at boot.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import sqlite3
 import threading
 import time
 import uuid
-from typing import Any, Iterable
+from typing import Iterable
 
 from llmlb_tpu.gateway.types import (
     AcceleratorInfo,

@@ -9,7 +9,6 @@ replaces the endpoint's model set in the registry.
 
 from __future__ import annotations
 
-import asyncio
 import logging
 
 import aiohttp

@@ -20,7 +20,6 @@ new, first-class component of the TPU build (BASELINE.json north star).
 from __future__ import annotations
 
 import dataclasses
-import math
 
 import jax
 import numpy as np
