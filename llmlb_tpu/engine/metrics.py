@@ -17,6 +17,10 @@ import threading
 # are single-digit ms on TPU.
 TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+# Per-dispatch step durations: prefill is tens of ms to seconds (bucketed
+# prompt groups), a decode step is single-digit ms on TPU (burst-amortized).
+STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                1.0, 2.5)
 
 
 class Histogram:
@@ -40,7 +44,10 @@ class Histogram:
             self.max = value
 
     def percentile(self, pct: float) -> float | None:
-        """Approximate percentile from bucket upper edges (None if empty).
+        """Approximate percentile, linearly interpolated within the landing
+        bucket (None if empty). The bucket's mass is assumed uniform between
+        its lower and upper edge (lower edge 0 for the first bucket), so a
+        sample entirely below the first edge no longer reports the full edge.
         Percentiles above the top edge report the max observed value — a
         finite, JSON-safe figure (`inf` would serialize as the non-standard
         `Infinity` token and break strict parsers of /api/health)."""
@@ -48,10 +55,14 @@ class Histogram:
             return None
         target = self.n * pct / 100.0
         seen = 0
+        lower = 0.0
         for i, edge in enumerate(self.edges):
-            seen += self.counts[i]
-            if seen >= target:
-                return edge
+            count = self.counts[i]
+            if count and seen + count >= target:
+                frac = (target - seen) / count
+                return lower + frac * (edge - lower)
+            seen += count
+            lower = edge
         return max(self.edges[-1], self.max)
 
 
@@ -64,6 +75,12 @@ class EngineMetrics:
         self.cancelled_total = 0
         self.ttft = Histogram(TTFT_BUCKETS)
         self.itl = Histogram(ITL_BUCKETS)
+        # Step-loop phase breakdown: duration of each prefill dispatch and
+        # each (burst-amortized) decode step, plus the decode batch occupancy
+        # at the last step — the figures every scheduling/perf PR tunes.
+        self.prefill_step = Histogram(STEP_BUCKETS)
+        self.decode_step = Histogram(STEP_BUCKETS)
+        self.batch_occupancy = 0
 
     # ------------------------------------------------------------ recorders
 
@@ -86,6 +103,19 @@ class EngineMetrics:
             self.tokens_total += 1
             if itl_seconds is not None:
                 self.itl.observe(itl_seconds)
+
+    def record_prefill_step(self, seconds: float) -> None:
+        with self._lock:
+            self.prefill_step.observe(seconds)
+
+    def record_decode_step(self, seconds: float, active_slots: int) -> None:
+        with self._lock:
+            self.decode_step.observe(seconds)
+            self.batch_occupancy = active_slots
+
+    def set_batch_occupancy(self, active_slots: int) -> None:
+        with self._lock:
+            self.batch_occupancy = active_slots
 
     def record_request_done(self, finish: str) -> None:
         with self._lock:
@@ -131,9 +161,15 @@ class EngineMetrics:
                 f"llmlb_engine_active_slots {active_slots}",
                 "# TYPE llmlb_engine_num_slots gauge",
                 f"llmlb_engine_num_slots {num_slots}",
+                "# TYPE llmlb_engine_batch_occupancy gauge",
+                f"llmlb_engine_batch_occupancy {self.batch_occupancy}",
             ]
-            for name, hist in (("llmlb_engine_ttft_seconds", self.ttft),
-                               ("llmlb_engine_itl_seconds", self.itl)):
+            for name, hist in (
+                ("llmlb_engine_ttft_seconds", self.ttft),
+                ("llmlb_engine_itl_seconds", self.itl),
+                ("llmlb_engine_prefill_step_seconds", self.prefill_step),
+                ("llmlb_engine_decode_step_seconds", self.decode_step),
+            ):
                 lines.append(f"# TYPE {name} histogram")
                 cumulative = 0
                 for i, edge in enumerate(hist.edges):

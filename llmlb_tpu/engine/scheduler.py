@@ -664,6 +664,7 @@ class EngineCore:
         lens[g:] = lens[g - 1]
         slot_ids[g:] = slot_ids[g - 1]
 
+        prefill_start = time.monotonic()
         logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
             self.params,
             self.cfg,
@@ -674,6 +675,10 @@ class EngineCore:
             self.cache_v,
             self.mesh,
         )
+        # jitted prefill returns futures (async dispatch); block before timing
+        # or the histogram records dispatch overhead, not device execution.
+        jax.block_until_ready(logits)
+        self.metrics.record_prefill_step(time.monotonic() - prefill_start)
         self._activate_group(group, slot_ids, lens, logits)
 
     def _activate_group(self, group: list[tuple[int, Request, int]],
@@ -740,9 +745,12 @@ class EngineCore:
         padded = self._cp_bucket_for(n)
         ids = np.zeros((1, padded), np.int32)
         ids[0, :n] = request.prompt_ids
+        prefill_start = time.monotonic()
         logits, k_all, v_all = self._cp_prefill_fn(
             self.params, jnp.asarray(ids), jnp.asarray([n], np.int32)
         )
+        jax.block_until_ready(logits)  # async dispatch; time real execution
+        self.metrics.record_prefill_step(time.monotonic() - prefill_start)
         # KV beyond n is padding garbage; it lands in cells past the valid
         # length (masked by decode attention and overwritten as the sequence
         # grows into them) — same contract as the chunked path.
@@ -784,6 +792,7 @@ class EngineCore:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :chunk_len] = request.prompt_ids[start:start + chunk_len]
 
+        prefill_start = time.monotonic()
         logits, self.cache_k, self.cache_v = self.family.prefill_extend_slots(
             self.params,
             self.cfg,
@@ -795,6 +804,8 @@ class EngineCore:
             self.cache_v,
             self.mesh,
         )
+        jax.block_until_ready(logits)  # async dispatch; time real execution
+        self.metrics.record_prefill_step(time.monotonic() - prefill_start)
 
         slot.prefill_pos = start + chunk_len
         if slot.prefill_pos >= n:
@@ -870,6 +881,9 @@ class EngineCore:
             if s.request is not None and not s.prefilling
         ]
         if not active:
+            # The occupancy gauge is otherwise only written on decode steps
+            # and would freeze at the last batch size on an idle engine.
+            self.metrics.set_batch_occupancy(0)
             return False
 
         self._key, sk = jax.random.split(self._key)
@@ -887,9 +901,9 @@ class EngineCore:
             # Tokens reach the host back-to-back, so wall-clock gaps between
             # _emit calls are ~0 and would poison the ITL histogram; record
             # the amortized per-token pacing of the burst instead.
-            self._emit_fetched(
-                tokens, active, itl=(time.monotonic() - burst_start) / k
-            )
+            step_s = (time.monotonic() - burst_start) / k
+            self.metrics.record_decode_step(step_s, len(active))
+            self._emit_fetched(tokens, active, itl=step_s)
             return True
 
         step_start = time.monotonic()
@@ -914,9 +928,9 @@ class EngineCore:
         # land in the same fetch, so the wall gap between them is ~0 and
         # would skew the histogram exactly like an unamortized burst.
         tokens = self._fetch_tokens(jnp.stack([first_in, tokens_dev]))
-        self._emit_fetched(
-            tokens, active, itl=time.monotonic() - step_start
-        )
+        step_s = time.monotonic() - step_start
+        self.metrics.record_decode_step(step_s, len(active))
+        self._emit_fetched(tokens, active, itl=step_s)
         return True
 
     def _emit_fetched(self, tokens, active: list[int],

@@ -15,6 +15,7 @@ import argparse
 import asyncio
 import json
 import logging
+import re
 import time
 import uuid
 
@@ -27,6 +28,24 @@ from llmlb_tpu.engine.service import Engine, EngineError
 log = logging.getLogger("llmlb_tpu.engine.server")
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # parity: reference caps /v1/* at 20 MiB
+
+
+# The gateway forwards its trace id on proxied calls; it becomes the prefix
+# of the scheduler request_id (service.py appends a unique suffix), joining
+# engine-side events to the gateway trace. Shape is enforced — the id
+# reaches logs and response headers.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_.:\-]{1,128}$")
+
+
+def _request_id_from(request: web.Request) -> str | None:
+    rid = request.headers.get("X-Request-Id")
+    if rid and _REQUEST_ID_RE.match(rid):
+        return rid
+    return None
+
+
+def _rid_headers(rid: str | None) -> dict:
+    return {"X-Request-Id": rid} if rid else {}
 
 
 def _error(status: int, message: str, err_type: str = "invalid_request_error"):
@@ -350,6 +369,7 @@ class EngineAPI:
 
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
+        rid = _request_id_from(request)
 
         if body.get("stream"):
             return await self._stream_chat(
@@ -357,10 +377,12 @@ class EngineAPI:
                 include_usage=bool(
                     (body.get("stream_options") or {}).get("include_usage", True)
                 ),
+                request_id=rid,
             )
 
         try:
-            result = await self.engine.complete(prompt_ids, sampling, stops)
+            result = await self.engine.complete(prompt_ids, sampling, stops,
+                                                request_id=rid)
         except EngineError as e:
             return _error(500, str(e), "server_error")
         except ValueError as e:
@@ -379,12 +401,13 @@ class EngineAPI:
                     }
                 ],
                 "usage": _usage(result.prompt_tokens, result.completion_tokens),
-            }
+            },
+            headers=_rid_headers(rid),
         )
 
     async def _stream_chat(
         self, request, completion_id, created, model, prompt_ids, sampling, stops,
-        include_usage: bool,
+        include_usage: bool, request_id: str | None = None,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -392,6 +415,7 @@ class EngineAPI:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **_rid_headers(request_id),
             },
         )
         await resp.prepare(request)
@@ -411,7 +435,8 @@ class EngineAPI:
         usage = _usage(len(prompt_ids), 0)
         finish = "stop"
         try:
-            async for delta in self.engine.stream(prompt_ids, sampling, stops):
+            async for delta in self.engine.stream(prompt_ids, sampling, stops,
+                                                  request_id=request_id):
                 if delta.text:
                     await _sse_send(resp, chunk({"content": delta.text}))
                 if delta.finish_reason is not None:
@@ -450,16 +475,19 @@ class EngineAPI:
         stops = _stops_from(body)
         completion_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
+        rid = _request_id_from(request)
 
         if body.get("stream"):
             resp = web.StreamResponse(
-                status=200, headers={"Content-Type": "text/event-stream"}
+                status=200, headers={"Content-Type": "text/event-stream",
+                                     **_rid_headers(rid)}
             )
             await resp.prepare(request)
             usage = _usage(len(prompt_ids), 0)
             finish = "stop"
             try:
-                async for delta in self.engine.stream(prompt_ids, sampling, stops):
+                async for delta in self.engine.stream(prompt_ids, sampling,
+                                                      stops, request_id=rid):
                     if delta.finish_reason is not None:
                         finish = delta.finish_reason
                         usage = _usage(delta.prompt_tokens, delta.completion_tokens)
@@ -495,7 +523,8 @@ class EngineAPI:
             await resp.write(b"data: [DONE]\n\n")
             return resp
 
-        result = await self.engine.complete(prompt_ids, sampling, stops)
+        result = await self.engine.complete(prompt_ids, sampling, stops,
+                                            request_id=rid)
         return web.json_response(
             {
                 "id": completion_id,
@@ -540,6 +569,7 @@ class EngineAPI:
         sampling = _sampling_from(body)
         response_id = f"resp_{uuid.uuid4().hex[:24]}"
         created = int(time.time())
+        rid = _request_id_from(request)
 
         def envelope(status: str, text: str, usage: dict | None) -> dict:
             return {
@@ -565,7 +595,8 @@ class EngineAPI:
 
         if body.get("stream"):
             resp = web.StreamResponse(
-                status=200, headers={"Content-Type": "text/event-stream"}
+                status=200, headers={"Content-Type": "text/event-stream",
+                                     **_rid_headers(rid)}
             )
             await resp.prepare(request)
 
@@ -582,7 +613,7 @@ class EngineAPI:
             usage = None
             try:
                 async for delta in self.engine.stream(
-                    prompt_ids, sampling, _stops_from(body)
+                    prompt_ids, sampling, _stops_from(body), request_id=rid
                 ):
                     if delta.text:
                         text_parts.append(delta.text)
@@ -627,13 +658,15 @@ class EngineAPI:
             )
             return resp
 
-        result = await self.engine.complete(prompt_ids, sampling, _stops_from(body))
+        result = await self.engine.complete(prompt_ids, sampling,
+                                            _stops_from(body), request_id=rid)
         usage = {
             "input_tokens": result.prompt_tokens,
             "output_tokens": result.completion_tokens,
             "total_tokens": result.prompt_tokens + result.completion_tokens,
         }
-        return web.json_response(envelope("completed", result.text, usage))
+        return web.json_response(envelope("completed", result.text, usage),
+                                 headers=_rid_headers(rid))
 
 
 @web.middleware

@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import AsyncIterator
 
@@ -110,15 +111,28 @@ class Engine:
         prompt_ids: list[int],
         sampling: SamplingParams,
         stop: list[str] | None = None,
+        request_id: str | None = None,
     ) -> AsyncIterator[StreamDelta]:
         """Submit and stream deltas. Final delta carries finish_reason + usage.
+
+        `request_id` (the gateway's X-Request-Id) prefixes the scheduler
+        request id, so engine-side logs/events join the gateway trace. A
+        unique suffix is always appended: the raw header is client-controlled
+        and the scheduler's cancellation bookkeeping is keyed by request_id,
+        so two in-flight requests must never share one.
 
         Stop sequences may straddle token/delta boundaries, so the last
         `max(len(stop)) - 1` characters are held back until the stream resolves;
         a stop hit truncates before anything past it is emitted. Early exit
         (stop hit, client gone) cancels the request so its slot frees promptly.
         """
-        request = Request(prompt_ids=prompt_ids, sampling=sampling)
+        if request_id:
+            request = Request(
+                prompt_ids=prompt_ids, sampling=sampling,
+                request_id=f"{request_id}.{uuid.uuid4().hex[:8]}",
+            )
+        else:
+            request = Request(prompt_ids=prompt_ids, sampling=sampling)
         loop = asyncio.get_running_loop()
         self.core.submit(request)
 
@@ -180,11 +194,13 @@ class Engine:
         prompt_ids: list[int],
         sampling: SamplingParams,
         stop: list[str] | None = None,
+        request_id: str | None = None,
     ) -> StreamDelta:
         """Non-streaming: collect the full completion."""
         text = []
         final: StreamDelta | None = None
-        async for delta in self.stream(prompt_ids, sampling, stop):
+        async for delta in self.stream(prompt_ids, sampling, stop,
+                                       request_id=request_id):
             text.append(delta.text)
             if delta.finish_reason is not None:
                 final = delta

@@ -29,6 +29,7 @@ from llmlb_tpu.gateway.api_openai import (
 )
 from llmlb_tpu.gateway.model_names import to_canonical
 from llmlb_tpu.gateway.token_accounting import estimate_tokens
+from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
 from llmlb_tpu.gateway.types import Capability, TpsApiKind
 
 ANTHROPIC_BASE = os.environ.get(
@@ -335,6 +336,9 @@ class AnthropicStreamEncoder:
 async def messages(request: web.Request) -> web.StreamResponse:
     state = request.app["state"]
     started = time.monotonic()
+    trace = request.get("trace")
+    if trace is not None:
+        trace.end("auth")
     try:
         body = await request.json()
     except Exception:
@@ -352,10 +356,13 @@ async def messages(request: web.Request) -> web.StreamResponse:
                                         model[len("anthropic:"):])
 
     canonical = to_canonical(model)
+    if trace is not None:
+        trace.model = canonical
     openai_body = anthropic_request_to_openai(body)
     try:
         selection = await select_endpoint_with_queue(
-            state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT
+            state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
+            trace=trace,
         )
     except QueueTimeout:
         return _anthropic_error(503, "all endpoints busy", "overloaded_error")
@@ -373,6 +380,11 @@ async def messages(request: web.Request) -> web.StreamResponse:
     headers = {"Content-Type": "application/json"}
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"
+    rid = request.get("request_id")
+    if rid:
+        headers[REQUEST_ID_HEADER] = rid
+    if trace is not None:
+        trace.begin("proxy")
     try:
         upstream = await state.http.post(
             endpoint.url + "/v1/chat/completions",
@@ -401,11 +413,14 @@ async def messages(request: web.Request) -> web.StreamResponse:
     if is_stream:
         return await _stream_transform(
             request, state, upstream, endpoint, canonical, started, lease,
-            body, openai_body,
+            body, openai_body, trace=trace,
         )
 
+    observe_first_token(state, trace, canonical, endpoint.name, started)
     raw = await upstream.read()
     upstream.release()
+    if trace is not None:
+        trace.end("proxy")
     try:
         openai_resp = json.loads(raw)
     except ValueError:
@@ -424,11 +439,13 @@ async def messages(request: web.Request) -> web.StreamResponse:
 
 async def _stream_transform(
     request, state, upstream, endpoint, model, started, lease,
-    original_body, openai_body,
+    original_body, openai_body, trace=None,
 ) -> web.StreamResponse:
-    resp = web.StreamResponse(
-        status=200, headers={"Content-Type": "text/event-stream"}
-    )
+    headers = {"Content-Type": "text/event-stream"}
+    rid = request.get("request_id")
+    if rid:
+        headers[REQUEST_ID_HEADER] = rid
+    resp = web.StreamResponse(status=200, headers=headers)
     await resp.prepare(request)
     lease.complete()
     # Estimate from the flattened OpenAI conversion: it folds system prompts
@@ -443,8 +460,13 @@ async def _stream_transform(
         input_token_estimate=estimate_tokens(prompt_text),
     )
     buffer = b""
+    first_chunk = True
     try:
         async for raw_chunk in upstream.content.iter_any():
+            if first_chunk:
+                first_chunk = False
+                observe_first_token(state, trace, model, endpoint.name,
+                                    started, streaming=True)
             buffer += raw_chunk
             while b"\n" in buffer:
                 line, buffer = buffer.split(b"\n", 1)
@@ -467,6 +489,9 @@ async def _stream_transform(
         pass
     finally:
         upstream.release()
+        if trace is not None:
+            trace.end("decode")
+            trace.end("proxy")
         ct = encoder.usage["output_tokens"]
         duration_s = time.monotonic() - started
         if ct:

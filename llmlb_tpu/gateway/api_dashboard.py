@@ -67,6 +67,7 @@ async def overview(request: web.Request) -> web.Response:
             "today": row["requests"], "errors_today": row["errors"],
         },
         "tokens_today": {"prompt": row["pt"], "completion": row["ct"]},
+        "latency": state.metrics.summary(),
         "tpu": {
             "total_chips": sum(e.accelerator.chip_count for e in online),
             "hbm_used_bytes": sum(e.accelerator.hbm_used_bytes for e in online),

@@ -29,6 +29,7 @@ from llmlb_tpu.gateway.token_accounting import (
     estimate_tokens,
     extract_usage_from_response,
 )
+from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
 from llmlb_tpu.gateway.types import Capability, Endpoint, TpsApiKind
 
 log = logging.getLogger("llmlb_tpu.gateway.openai")
@@ -52,21 +53,40 @@ def parse_cloud_prefix(model: str) -> tuple[str | None, str]:
 
 
 async def select_endpoint_with_queue(
-    state: AppState, model: str, capability: Capability, api_kind: TpsApiKind
+    state: AppState, model: str, capability: Capability, api_kind: TpsApiKind,
+    trace=None,
 ) -> tuple[Endpoint, str, "RequestLease"] | None:
     """Atomically TPS-select and lease an endpoint serving the model; if all
     are at the admission cap, park on the AdmissionQueue until a lease release
     wakes us or the queue timeout passes (notify-based, no polling — parity:
-    balancer/mod.rs:2273-2427)."""
+    balancer/mod.rs:2273-2427). Records admission/queue_wait/endpoint_select
+    spans on `trace` and feeds the gateway queue-wait histogram."""
     if not state.registry.find_by_model(model, capability):
         return None
 
     def get_endpoints() -> list[Endpoint]:
         return [ep for ep, _ in state.registry.find_by_model(model, capability)]
 
+    if trace is not None:
+        trace.begin("admission")
+    admit_start = time.monotonic()
     result = await state.admission.admit(get_endpoints, model, api_kind)
     if not result.admitted:
+        state.metrics.record_queue_timeout(model)
+        state.metrics.record_queue_wait(model, "none", result.waited_s)
+        if trace is not None:
+            trace.end("admission")
+            trace.add_span("queue_wait", start_monotonic=admit_start,
+                           duration_s=result.waited_s)
         raise QueueTimeout(result.queue_position, result.waited_s)
+    state.metrics.record_queue_wait(model, result.endpoint.name,
+                                    result.waited_s)
+    if trace is not None:
+        trace.end("admission")
+        trace.add_span("queue_wait", start_monotonic=admit_start,
+                       duration_s=result.waited_s)
+        trace.mark("endpoint_select", endpoint=result.endpoint.name)
+        trace.set_endpoint(result.endpoint)
     pairs = state.registry.find_by_model(model, capability)
     engine_model = next(
         (m.model_id for ep, m in pairs if ep.id == result.endpoint.id),
@@ -93,6 +113,9 @@ def _record(
 ) -> None:
     duration_ms = (time.monotonic() - started) * 1000.0
     eid = endpoint.id if endpoint else None
+    state.metrics.record_e2e(
+        model, endpoint.name if endpoint else "none", duration_ms / 1000.0
+    )
     state.load_manager.record_request(RequestRecord(
         ts=time.time(), endpoint_id=eid or "", model=model, api_kind=api_kind,
         status_code=status, duration_ms=duration_ms,
@@ -129,6 +152,9 @@ async def proxy_openai_post(
     """The generic select→rewrite→forward→account pipeline for /v1/* POSTs."""
     state: AppState = request.app["state"]
     started = time.monotonic()
+    trace = request.get("trace")
+    if trace is not None:
+        trace.end("auth")
     try:
         body = await request.json()
     except Exception:
@@ -148,9 +174,11 @@ async def proxy_openai_post(
         )
 
     canonical = to_canonical(model)
+    if trace is not None:
+        trace.model = canonical
     try:
         selection = await select_endpoint_with_queue(
-            state, canonical, capability, api_kind
+            state, canonical, capability, api_kind, trace=trace
         )
     except QueueTimeout as qt:
         return error_response(
@@ -181,6 +209,10 @@ async def proxy_openai_post(
     headers = {"Content-Type": "application/json"}
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"
+    rid = request.get("request_id")
+    if rid:
+        # the engine scheduler adopts this id, joining the gateway trace
+        headers[REQUEST_ID_HEADER] = rid
 
     client_ip = request.remote
     auth = request.get("auth")
@@ -189,6 +221,8 @@ async def proxy_openai_post(
     # (the reference's sanitization contract, implemented)
     stored_body = sanitize_request_body(body)
 
+    if trace is not None:
+        trace.begin("proxy")
     try:
         upstream = await state.http.post(
             endpoint.url + path,
@@ -227,10 +261,14 @@ async def proxy_openai_post(
         return await _forward_stream(
             request, state, upstream, endpoint, canonical, api_kind, path,
             started, lease, prompt_text, client_ip, auth, stored_body,
+            trace=trace,
         )
 
+    observe_first_token(state, trace, canonical, endpoint.name, started)
     raw = await upstream.read()
     upstream.release()
+    if trace is not None:
+        trace.end("proxy")
     try:
         parsed = json.loads(raw)
     except ValueError:
@@ -254,22 +292,29 @@ async def proxy_openai_post(
 async def _forward_stream(
     request, state: AppState, upstream, endpoint, model, api_kind, path,
     started, lease, prompt_text, client_ip, auth, stored_body=None,
+    trace=None,
 ) -> web.StreamResponse:
     """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120)."""
-    resp = web.StreamResponse(
-        status=200,
-        headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-        },
-    )
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+    }
+    rid = request.get("request_id")
+    if rid:  # set pre-prepare; the middleware cannot amend a sent stream
+        headers[REQUEST_ID_HEADER] = rid
+    resp = web.StreamResponse(status=200, headers=headers)
     await resp.prepare(request)
     lease.complete()  # endpoint accepted the stream; active slot released
     acc = StreamingTokenAccumulator()
     status = 200
     error = None
+    first_chunk = True
     try:
         async for chunk in upstream.content.iter_any():
+            if first_chunk:
+                first_chunk = False
+                observe_first_token(state, trace, model, endpoint.name,
+                                    started, streaming=True)
             acc.feed(chunk)
             await resp.write(chunk)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
@@ -277,6 +322,9 @@ async def _forward_stream(
         status, error = 502, f"stream interrupted: {type(e).__name__}"
     finally:
         upstream.release()
+        if trace is not None:
+            trace.end("decode")
+            trace.end("proxy")
         pt, ct, reported = acc.finalize(prompt_text)
         duration_s = time.monotonic() - started
         if ct > 0:

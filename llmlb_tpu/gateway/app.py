@@ -23,6 +23,7 @@ from llmlb_tpu.gateway import (
     api_media,
     api_models,
     api_openai,
+    tracing,
 )
 from llmlb_tpu.gateway.app_state import AppState
 from llmlb_tpu.gateway.audit import AuditEntry
@@ -32,6 +33,7 @@ from llmlb_tpu.gateway.auth import (
     AuthError,
     verify_jwt,
 )
+from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, mint_request_id
 from llmlb_tpu.gateway.types import Permission
 
 log = logging.getLogger("llmlb_tpu.gateway.app")
@@ -42,6 +44,7 @@ PUBLIC_PATHS = {
     ("POST", "/api/auth/login"),
     ("POST", "/api/auth/register"),
     ("GET", "/health"),
+    ("GET", "/metrics"),  # Prometheus scrape, same stance as the engine's
     ("GET", "/"),
 }
 
@@ -57,12 +60,67 @@ _API_KEY_PERMS: list[tuple[str, str, Permission]] = [
     ("GET", "/api/metrics", Permission.METRICS_READ),
     ("GET", "/api/models/registry", Permission.REGISTRY_READ),
     ("GET", "/api/benchmarks", Permission.METRICS_READ),
+    ("GET", "/api/traces", Permission.METRICS_READ),
 ]
+
+
+def _is_traced_path(path: str) -> bool:
+    """Inference paths get full lifecycle traces (every request gets an id)."""
+    return path.startswith("/v1/") or (
+        path.startswith("/api/endpoints/")
+        and path.endswith("/chat/completions")
+    )
+
+
+def _route_label(request: web.Request) -> str | None:
+    """Matched route pattern (e.g. '/v1/chat/completions') — a bounded label
+    set; unmatched requests return None and are not counted."""
+    resource = getattr(request.match_info.route, "resource", None)
+    return getattr(resource, "canonical", None)
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """Outermost: mints/reuses X-Request-Id, echoes it on every response
+    (success and error paths), records the lifecycle trace for inference
+    requests, and counts requests/errors per route in GatewayMetrics."""
+    state: AppState = request.app["state"]
+    rid = mint_request_id(request.headers.get(REQUEST_ID_HEADER))
+    request["request_id"] = rid
+    trace = None
+    if _is_traced_path(request.path):
+        trace = state.traces.start(rid, request.method, request.path)
+        # auth covers the middleware stack up to the handler; the inference
+        # handlers close it on entry, finish() closes it on rejection.
+        trace.begin("auth")
+        request["trace"] = trace
+    status = 500
+    error = None
+    try:
+        response = await handler(request)
+        status = response.status
+        if not response.prepared:  # streamed responses set it pre-prepare
+            response.headers[REQUEST_ID_HEADER] = rid
+        return response
+    except web.HTTPException as e:
+        status = e.status
+        e.headers[REQUEST_ID_HEADER] = rid
+        raise
+    except Exception as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        if trace is not None:
+            state.traces.finish(trace, status, error)
+        route = _route_label(request)
+        if route is not None and request.path != "/metrics":
+            state.metrics.record_request(route, status)
 
 
 @web.middleware
 async def audit_middleware(request: web.Request, handler):
-    """Outermost: every request lands in the tamper-evident audit log."""
+    """Directly inside tracing: every request lands in the tamper-evident
+    audit log."""
     state: AppState = request.app["state"]
     start = time.monotonic()
     status = 500
@@ -297,7 +355,8 @@ def create_app(state: AppState) -> web.Application:
     app = web.Application(
         client_max_size=MAX_BODY_BYTES,
         middlewares=[
-            audit_middleware, gate_middleware, csrf_middleware, auth_middleware,
+            tracing_middleware, audit_middleware, gate_middleware,
+            csrf_middleware, auth_middleware,
         ],
     )
     app["state"] = state
@@ -422,6 +481,11 @@ def create_app(state: AppState) -> web.Application:
     r.add_get("/api/benchmarks/tps/{run_id}", api_benchmarks.get_tps_benchmark)
     r.add_get("/api/metrics/cloud", api_cloud.cloud_metrics_handler)
 
+    # ---- observability: request traces + gateway-wide Prometheus metrics
+    r.add_get("/api/traces", tracing.list_traces)
+    r.add_get("/api/traces/{trace_id}", tracing.get_trace)
+    r.add_get("/metrics", _gateway_metrics)
+
     # ---- update lifecycle
     r.add_post("/api/system/update/check", _update_check)
     r.add_post("/api/system/update/apply", _update_apply)
@@ -448,6 +512,27 @@ def create_app(state: AppState) -> web.Application:
 
 async def _health(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok"})
+
+
+async def _gateway_metrics(request: web.Request) -> web.Response:
+    """GET /metrics — gateway-wide Prometheus exposition: per-model/endpoint
+    TTFT, e2e, and queue-wait histograms, per-route counters, plus
+    scrape-time gauges owned by the balancer and event bus."""
+    state: AppState = request.app["state"]
+    text = state.metrics.render(
+        counters={
+            "llmlb_gateway_dropped_events_total":
+                state.events.dropped_events_total(),
+        },
+        gauges={
+            "llmlb_gateway_active_requests":
+                state.load_manager.total_active(),
+            "llmlb_gateway_admission_queue_depth":
+                state.admission.queue_depth(),
+            "llmlb_gateway_traces_buffered": len(state.traces),
+        },
+    )
+    return web.Response(text=text, content_type="text/plain", charset="utf-8")
 
 
 async def _root(request: web.Request) -> web.Response:

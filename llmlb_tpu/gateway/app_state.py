@@ -25,12 +25,14 @@ from llmlb_tpu.gateway.auth import (
     ensure_admin_exists,
 )
 from llmlb_tpu.gateway.balancer import AdmissionQueue, LoadManager
-from llmlb_tpu.gateway.config import QueueConfig, ServerConfig
+from llmlb_tpu.gateway.config import QueueConfig, ServerConfig, env_int
 from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
 from llmlb_tpu.gateway.gate import InferenceGate
 from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.metrics import GatewayMetrics
 from llmlb_tpu.gateway.registry import EndpointRegistry
+from llmlb_tpu.gateway.tracing import TraceStore
 from llmlb_tpu.gateway.types import TpsApiKind
 
 log = logging.getLogger("llmlb_tpu.gateway")
@@ -51,6 +53,8 @@ class AppState:
     invitations: InvitationStore
     jwt_secret: str
     http: aiohttp.ClientSession
+    metrics: GatewayMetrics
+    traces: TraceStore
     health_checker: EndpointHealthChecker | None = None
     update_manager: object | None = None  # set by gateway.update
     tray: object | None = None  # TrayController when LLMLB_TRAY=1
@@ -88,6 +92,10 @@ async def build_app_state(
     events = DashboardEventBus()
     gate = InferenceGate()
     audit = AuditLog(db)
+    metrics = GatewayMetrics()
+    admission.metrics = metrics  # admission-retry counter (balancer.py)
+    traces = TraceStore(capacity=env_int("LLMLB_TRACE_BUFFER", 256),
+                        events=events)
 
     users = UserStore(db)
     api_keys = ApiKeyStore(db)
@@ -123,6 +131,7 @@ async def build_app_state(
         config=config, db=db, registry=registry, load_manager=load_manager,
         admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
+        metrics=metrics, traces=traces,
     )
 
     _seed_tps_from_daily_stats(state)

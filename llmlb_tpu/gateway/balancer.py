@@ -440,6 +440,9 @@ class AdmissionQueue:
         self.manager = manager
         self._tickets: deque[_Ticket] = deque()
         self._loop: "asyncio.AbstractEventLoop | None" = None
+        # GatewayMetrics, attached by app_state: counts admission
+        # re-attempts by parked waiters, labeled by API kind.
+        self.metrics = None
         manager.on_release = self._on_release
 
     # ---------------------------------------------------------------- waking
@@ -518,6 +521,8 @@ class AdmissionQueue:
                     )
                 except asyncio.TimeoutError:
                     pass  # fall through to retry; deadline checked at top
+                if self.metrics is not None:
+                    self.metrics.record_retry(api_kind.value)
                 got = self.manager.try_admit(get_endpoints(), model, api_kind)
                 if got is not None:
                     return WaitResult(

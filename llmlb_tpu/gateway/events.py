@@ -23,6 +23,7 @@ class DashboardEventBus:
         "TpsUpdated",
         "UpdateStateChanged",
         "TelemetryUpdated",
+        "TraceCompleted",
     )
 
     def __init__(self, queue_size: int = 256):
@@ -31,6 +32,11 @@ class DashboardEventBus:
         self._loops: dict[int, asyncio.AbstractEventLoop] = {}
         self._next_id = 0
         self._lock = threading.Lock()
+        # Dropped-event accounting: a slow subscriber silently losing events
+        # is invisible without it. Per-subscriber counts reset with the
+        # subscription; the total survives for /metrics.
+        self._dropped: dict[int, int] = {}
+        self._dropped_total = 0
 
     def subscribe(self) -> tuple[int, asyncio.Queue]:
         """Called from the event loop that will consume the queue."""
@@ -41,12 +47,22 @@ class DashboardEventBus:
             self._next_id += 1
             self._subscribers[sub_id] = q
             self._loops[sub_id] = loop
+            self._dropped[sub_id] = 0
         return sub_id, q
 
     def unsubscribe(self, sub_id: int) -> None:
         with self._lock:
             self._subscribers.pop(sub_id, None)
             self._loops.pop(sub_id, None)
+            self._dropped.pop(sub_id, None)
+
+    def dropped_events(self, sub_id: int) -> int:
+        with self._lock:
+            return self._dropped.get(sub_id, 0)
+
+    def dropped_events_total(self) -> int:
+        with self._lock:
+            return self._dropped_total
 
     def publish(self, event_type: str, payload: dict[str, Any] | None = None) -> None:
         """Thread-safe: usable from engine threads and the health checker."""
@@ -63,12 +79,17 @@ class DashboardEventBus:
             if loop is None or loop.is_closed():
                 continue
 
-            def _put(q=q, event=event):
+            def _put(q=q, event=event, sub_id=sub_id):
                 if q.full():
                     try:
                         q.get_nowait()  # drop oldest for slow consumers
                     except asyncio.QueueEmpty:
                         pass
+                    else:
+                        with self._lock:
+                            if sub_id in self._dropped:
+                                self._dropped[sub_id] += 1
+                            self._dropped_total += 1
                 q.put_nowait(event)
 
             try:

@@ -1,0 +1,181 @@
+"""Gateway-wide Prometheus metrics: the request-path figures the balancer
+cannot see from inside one engine.
+
+Same dependency-free idiom as EngineMetrics (llmlb_tpu/engine/metrics.py):
+plain counters and bucketed histograms behind one lock, rendered in
+Prometheus text exposition at GET /metrics. Histograms are labeled
+per (model, endpoint) so a slow request can be attributed to queueing vs
+the engine, and to WHICH engine — the per-phase breakdown every serving
+paper tunes against, now observable at the gateway layer.
+
+Series:
+  llmlb_gateway_requests_total{route,status}   counter
+  llmlb_gateway_errors_total{route}            counter (status >= 400)
+  llmlb_gateway_retries_total{api}             counter (admission re-attempts)
+  llmlb_gateway_queue_timeouts_total{model}    counter
+  llmlb_gateway_ttft_seconds{model,endpoint}   histogram
+  llmlb_gateway_e2e_seconds{model,endpoint}    histogram
+  llmlb_gateway_queue_wait_seconds{model,endpoint} histogram
+plus scrape-time gauges (active requests, admission queue depth, event-bus
+drops, trace-buffer size) injected by the /metrics handler.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from llmlb_tpu.engine.metrics import Histogram
+
+# Gateway-side latency edges: TTFT spans engine prefill plus proxy overhead
+# (tens of ms to tens of seconds for queued long prompts); queue wait spans
+# sub-ms fast-path admissions to the 30 s queue timeout.
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+E2E_BUCKETS = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+               60.0, 120.0)
+QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                      5.0, 10.0, 30.0)
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class GatewayMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests: dict[tuple[str, int], int] = defaultdict(int)
+        self._errors: dict[str, int] = defaultdict(int)
+        self._retries: dict[str, int] = defaultdict(int)
+        self._queue_timeouts: dict[str, int] = defaultdict(int)
+        # (model, endpoint) -> Histogram
+        self._ttft: dict[tuple[str, str], Histogram] = {}
+        self._e2e: dict[tuple[str, str], Histogram] = {}
+        self._queue_wait: dict[tuple[str, str], Histogram] = {}
+
+    # ------------------------------------------------------------ recorders
+
+    def record_request(self, route: str, status: int) -> None:
+        with self._lock:
+            self._requests[(route, status)] += 1
+            if status >= 400:
+                self._errors[route] += 1
+
+    def record_retry(self, api: str) -> None:
+        """One admission re-attempt after parking on the queue, labeled by
+        API kind ('chat', 'completion', ...) — the admission queue sits below
+        route matching and never sees the route pattern."""
+        with self._lock:
+            self._retries[api] += 1
+
+    def record_queue_timeout(self, model: str) -> None:
+        with self._lock:
+            self._queue_timeouts[model] += 1
+
+    def _observe(self, table: dict, buckets: tuple[float, ...],
+                 model: str, endpoint: str, seconds: float) -> None:
+        with self._lock:
+            hist = table.get((model, endpoint))
+            if hist is None:
+                hist = table[(model, endpoint)] = Histogram(buckets)
+            hist.observe(seconds)
+
+    def record_ttft(self, model: str, endpoint: str, seconds: float) -> None:
+        self._observe(self._ttft, TTFT_BUCKETS, model, endpoint, seconds)
+
+    def record_e2e(self, model: str, endpoint: str, seconds: float) -> None:
+        self._observe(self._e2e, E2E_BUCKETS, model, endpoint, seconds)
+
+    def record_queue_wait(self, model: str, endpoint: str,
+                          seconds: float) -> None:
+        self._observe(self._queue_wait, QUEUE_WAIT_BUCKETS, model, endpoint,
+                      seconds)
+
+    # ----------------------------------------------------------- exposition
+
+    def summary(self) -> dict:
+        """Compact JSON figures (bench tooling + dashboard overview)."""
+        with self._lock:
+            def pcts(table: dict) -> dict:
+                merged: Histogram | None = None
+                for hist in table.values():
+                    if merged is None:
+                        merged = Histogram(hist.edges)
+                    for i, c in enumerate(hist.counts):
+                        merged.counts[i] += c
+                    merged.total += hist.total
+                    merged.n += hist.n
+                    merged.max = max(merged.max, hist.max)
+                if merged is None:
+                    return {"p50": None, "p99": None, "count": 0}
+                return {"p50": merged.percentile(50),
+                        "p99": merged.percentile(99), "count": merged.n}
+
+            return {
+                "requests_total": sum(self._requests.values()),
+                "errors_total": sum(self._errors.values()),
+                "retries_total": sum(self._retries.values()),
+                "queue_timeouts_total": sum(self._queue_timeouts.values()),
+                "ttft_s": pcts(self._ttft),
+                "e2e_s": pcts(self._e2e),
+                "queue_wait_s": pcts(self._queue_wait),
+            }
+
+    def render(self, *, gauges: dict[str, float] | None = None,
+               counters: dict[str, float] | None = None) -> str:
+        """Prometheus text exposition. `gauges`/`counters` hold scrape-time
+        figures owned elsewhere (load manager, admission queue, event bus)."""
+        with self._lock:
+            lines = ["# TYPE llmlb_gateway_requests_total counter"]
+            for (route, status), n in sorted(self._requests.items()):
+                lines.append(
+                    f'llmlb_gateway_requests_total{{route="{_escape(route)}",'
+                    f'status="{status}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_errors_total counter")
+            for route, n in sorted(self._errors.items()):
+                lines.append(
+                    f'llmlb_gateway_errors_total{{route="{_escape(route)}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_retries_total counter")
+            for api, n in sorted(self._retries.items()):
+                lines.append(
+                    f'llmlb_gateway_retries_total{{api="{_escape(api)}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_queue_timeouts_total counter")
+            for model, n in sorted(self._queue_timeouts.items()):
+                lines.append(
+                    f'llmlb_gateway_queue_timeouts_total'
+                    f'{{model="{_escape(model)}"}} {n}'
+                )
+            for name, table in (
+                ("llmlb_gateway_ttft_seconds", self._ttft),
+                ("llmlb_gateway_e2e_seconds", self._e2e),
+                ("llmlb_gateway_queue_wait_seconds", self._queue_wait),
+            ):
+                lines.append(f"# TYPE {name} histogram")
+                for (model, endpoint), hist in sorted(table.items()):
+                    labels = (f'model="{_escape(model)}",'
+                              f'endpoint="{_escape(endpoint)}"')
+                    cumulative = 0
+                    for i, edge in enumerate(hist.edges):
+                        cumulative += hist.counts[i]
+                        lines.append(
+                            f'{name}_bucket{{{labels},le="{edge}"}} '
+                            f'{cumulative}'
+                        )
+                    cumulative += hist.counts[-1]
+                    lines.append(
+                        f'{name}_bucket{{{labels},le="+Inf"}} {cumulative}'
+                    )
+                    lines.append(f"{name}_sum{{{labels}}} {hist.total}")
+                    lines.append(f"{name}_count{{{labels}}} {hist.n}")
+            for cname, value in sorted((counters or {}).items()):
+                lines.append(f"# TYPE {cname} counter")
+                lines.append(f"{cname} {value}")
+            for gname, value in sorted((gauges or {}).items()):
+                lines.append(f"# TYPE {gname} gauge")
+                lines.append(f"{gname} {value}")
+            return "\n".join(lines) + "\n"

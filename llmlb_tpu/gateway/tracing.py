@@ -1,0 +1,245 @@
+"""Request-lifecycle tracing: gateway → balancer → engine.
+
+Every inbound request gets a trace id (a client-supplied ``X-Request-Id``
+is reused when well-formed, otherwise one is minted), echoed on the
+response so clients can correlate their logs with gateway traces.
+Inference requests additionally record ordered spans — ``auth``,
+``admission``, ``queue_wait``, ``endpoint_select``, ``proxy``,
+``first_token``, ``decode``, ``done`` — with monotonic timestamps, and the
+id is forwarded on the proxied call via ``X-Request-Id`` so the engine
+scheduler's ``request_id`` joins the same trace. Completed traces live in
+a bounded ring buffer served at ``GET /api/traces`` (+ ``/{id}``) and are
+announced on the dashboard event bus as ``TraceCompleted`` events.
+
+No reference counterpart: the reference router only logs per-request
+lines. This is the shared spine later perf PRs measure themselves
+against — TTFT vs queue wait vs engine step time, per request.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+
+from aiohttp import web
+
+REQUEST_ID_HEADER = "X-Request-Id"
+
+# Client-supplied ids are echoed into headers, logs, and label-adjacent
+# places; anything outside this shape is replaced, not trusted.
+_ID_RE = re.compile(r"^[A-Za-z0-9_.:\-]{1,128}$")
+
+# Canonical lifecycle order, used by consumers (dashboard, tests) to lay
+# spans out; traces may omit phases a request never reached.
+SPAN_ORDER = ("auth", "admission", "queue_wait", "endpoint_select", "proxy",
+              "first_token", "decode", "done")
+
+
+def mint_request_id(raw: str | None) -> str:
+    if raw and _ID_RE.match(raw):
+        return raw
+    return uuid.uuid4().hex
+
+
+class RequestTrace:
+    """Ordered spans over one request's lifetime. Touched only from the
+    event loop; durations come from one monotonic clock."""
+
+    def __init__(self, trace_id: str, method: str, path: str):
+        self.trace_id = trace_id
+        self.method = method
+        self.path = path
+        self.started_at = time.time()
+        self.t0 = time.monotonic()
+        self.model: str | None = None
+        self.endpoint_id: str | None = None
+        self.endpoint_name: str | None = None
+        self.status: int | None = None
+        self.error: str | None = None
+        self.duration_ms: float | None = None
+        self.spans: list[dict] = []
+        self._open: dict[str, int] = {}  # name -> index into spans
+
+    # --------------------------------------------------------------- spans
+
+    def begin(self, name: str) -> None:
+        if name in self._open:
+            return
+        self._open[name] = len(self.spans)
+        self.spans.append({
+            "name": name,
+            "start_ms": round((time.monotonic() - self.t0) * 1000.0, 3),
+            "duration_ms": None,
+        })
+
+    def end(self, name: str) -> None:
+        idx = self._open.pop(name, None)
+        if idx is None:
+            return
+        span = self.spans[idx]
+        now_ms = (time.monotonic() - self.t0) * 1000.0
+        span["duration_ms"] = round(max(0.0, now_ms - span["start_ms"]), 3)
+
+    def mark(self, name: str, **attrs) -> None:
+        """Point-in-time span (duration 0)."""
+        span = {
+            "name": name,
+            "start_ms": round((time.monotonic() - self.t0) * 1000.0, 3),
+            "duration_ms": 0.0,
+        }
+        if attrs:
+            span["attrs"] = attrs
+        self.spans.append(span)
+
+    def add_span(self, name: str, *, start_monotonic: float,
+                 duration_s: float, **attrs) -> None:
+        """Span with caller-measured bounds (e.g. queue_wait from the
+        admission queue's own waited_s)."""
+        span = {
+            "name": name,
+            "start_ms": round((start_monotonic - self.t0) * 1000.0, 3),
+            "duration_ms": round(max(0.0, duration_s) * 1000.0, 3),
+        }
+        if attrs:
+            span["attrs"] = attrs
+        self.spans.append(span)
+
+    def set_endpoint(self, endpoint) -> None:
+        self.endpoint_id = endpoint.id
+        self.endpoint_name = endpoint.name
+
+    # -------------------------------------------------------------- finish
+
+    def finish(self, status: int, error: str | None = None) -> None:
+        now_ms = (time.monotonic() - self.t0) * 1000.0
+        for name in list(self._open):
+            self.end(name)
+        self.status = status
+        self.error = error
+        self.duration_ms = round(now_ms, 3)
+        self.spans.sort(key=lambda s: s["start_ms"])
+        self.spans.append({
+            "name": "done", "start_ms": round(now_ms, 3), "duration_ms": 0.0,
+        })
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "method": self.method,
+            "path": self.path,
+            "started_at": self.started_at,
+            "model": self.model,
+            "endpoint_id": self.endpoint_id,
+            "endpoint_name": self.endpoint_name,
+            "status": self.status,
+            "error": self.error,
+            "duration_ms": self.duration_ms,
+            "spans": self.spans,
+        }
+
+
+class TraceStore:
+    """Bounded ring of completed traces + the in-flight set. Thread-safe:
+    completion may be observed from bench/scrape threads."""
+
+    def __init__(self, capacity: int = 256, events=None):
+        self.capacity = max(1, capacity)
+        self._events = events  # DashboardEventBus | None
+        self._lock = threading.Lock()
+        self._active: "OrderedDict[str, RequestTrace]" = OrderedDict()
+        self._done: deque[RequestTrace] = deque(maxlen=self.capacity)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._done)
+
+    def start(self, trace_id: str, method: str, path: str) -> RequestTrace:
+        trace = RequestTrace(trace_id, method, path)
+        with self._lock:
+            # A reused client id replaces any stale in-flight entry rather
+            # than leaking it.
+            self._active[trace.trace_id] = trace
+            while len(self._active) > self.capacity:
+                self._active.popitem(last=False)
+        return trace
+
+    def finish(self, trace: RequestTrace, status: int,
+               error: str | None = None) -> None:
+        trace.finish(status, error)
+        with self._lock:
+            # Identity check: a reused client id may have replaced this
+            # trace's slot with a newer in-flight trace — don't evict it.
+            if self._active.get(trace.trace_id) is trace:
+                del self._active[trace.trace_id]
+            self._done.append(trace)
+        if self._events is not None:
+            self._events.publish("TraceCompleted", {
+                "trace_id": trace.trace_id,
+                "path": trace.path,
+                "model": trace.model,
+                "endpoint_id": trace.endpoint_id,
+                "status": status,
+                "duration_ms": trace.duration_ms,
+            })
+
+    def get(self, trace_id: str) -> dict | None:
+        with self._lock:
+            trace = self._active.get(trace_id)
+            if trace is not None:
+                d = trace.to_dict()
+                d["in_flight"] = True
+                return d
+            for t in self._done:
+                if t.trace_id == trace_id:
+                    d = t.to_dict()
+                    d["in_flight"] = False
+                    return d
+        return None
+
+    def list(self, limit: int = 100) -> list[dict]:
+        """Most-recent-first completed traces (non-positive limit: none)."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            out = [t.to_dict() for t in list(self._done)[-limit:]]
+        out.reverse()
+        return out
+
+
+def observe_first_token(state, trace, model: str, endpoint_name: str,
+                        started: float, *, streaming: bool = False) -> None:
+    """First-byte-from-upstream bookkeeping, applied identically by every
+    proxy path: records the gateway TTFT histogram and the ``first_token``
+    trace mark; on streams also opens the ``decode`` span. Non-streaming
+    callers invoke it at the response boundary, where first token and
+    end-to-end coincide."""
+    state.metrics.record_ttft(model, endpoint_name,
+                              time.monotonic() - started)
+    if trace is not None:
+        trace.mark("first_token")
+        if streaming:
+            trace.begin("decode")
+
+
+# ------------------------------------------------------------------ handlers
+
+
+async def list_traces(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    try:
+        limit = min(int(request.query.get("limit", 100)), 500)
+    except ValueError:
+        return web.json_response({"error": "limit must be an integer"},
+                                 status=400)
+    return web.json_response({"traces": state.traces.list(limit)})
+
+
+async def get_trace(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    trace = state.traces.get(request.match_info["trace_id"])
+    if trace is None:
+        return web.json_response({"error": "trace not found"}, status=404)
+    return web.json_response(trace)

@@ -11,6 +11,10 @@ same thing for this gateway: an in-process mock OpenAI upstream, the real app
 Prints one JSON line. Python/aiohttp will not reach a Rust router's ceiling;
 the number is tracked honestly in bench_runs/MEASUREMENTS.md and bounds how
 much gateway CPU one TPU engine's request rate can consume.
+
+The gateway's own /metrics is scraped before and after the timed window and
+the TTFT/E2E/queue-wait percentile deltas are printed under "prometheus", so
+bench output and the Prometheus view agree on one source of truth.
 """
 
 from __future__ import annotations
@@ -18,8 +22,69 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import re
 import sys
 import time
+
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}\s+(-?[0-9.eE+]+)$"
+)
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+GATEWAY_HISTOGRAMS = (
+    "llmlb_gateway_ttft_seconds",
+    "llmlb_gateway_e2e_seconds",
+    "llmlb_gateway_queue_wait_seconds",
+)
+
+
+def parse_gateway_histograms(text: str) -> dict:
+    """Cumulative bucket counts per histogram family, summed across label
+    sets (models/endpoints): {family: {le: count}}."""
+    out: dict[str, dict[str, float]] = {name: {} for name in GATEWAY_HISTOGRAMS}
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.group(1), m.group(2), float(m.group(3))
+        for family in GATEWAY_HISTOGRAMS:
+            if name == family + "_bucket":
+                le = _LE_RE.search(labels)
+                if le:
+                    buckets = out[family]
+                    buckets[le.group(1)] = buckets.get(le.group(1), 0.0) + value
+    return out
+
+
+def delta_percentile(before: dict, after: dict, pct: float) -> float | None:
+    """Percentile of the requests observed BETWEEN two scrapes, linearly
+    interpolated within the landing bucket — the same estimate Prometheus'
+    histogram_quantile makes over a rate() window."""
+    edges = sorted((k for k in after if k != "+Inf"), key=float)
+    deltas = []
+    for le in edges + ["+Inf"]:
+        deltas.append(after.get(le, 0.0) - before.get(le, 0.0))
+    total = deltas[-1]
+    if total <= 0:
+        return None
+    target = total * pct / 100.0
+    lower = 0.0
+    prev_cum = 0.0
+    for le, cum in zip(edges, deltas[:-1]):
+        count = cum - prev_cum
+        if count > 0 and cum >= target:
+            frac = (target - prev_cum) / count
+            return lower + frac * (float(le) - lower)
+        prev_cum = cum
+        lower = float(le)
+    return float(edges[-1]) if edges else None
+
+
+async def scrape_metrics(gw) -> dict:
+    """One GET /metrics, parsed into per-family cumulative buckets."""
+    resp = await gw.client.get("/metrics")
+    assert resp.status == 200, await resp.text()
+    return parse_gateway_histograms(await resp.text())
 
 
 async def run_bench(seconds: float, concurrency: int) -> dict:
@@ -43,6 +108,10 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
             )
             assert resp.status == 200, await resp.text()
             await resp.read()
+
+        # Scrape-before: the percentile deltas below cover exactly the timed
+        # window, so bench output and Prometheus agree on one source of truth.
+        before = await scrape_metrics(gw)
 
         latencies: list[float] = []
         done = 0
@@ -70,6 +139,17 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
         await asyncio.gather(*(worker() for _ in range(concurrency)))
         elapsed = time.perf_counter() - t0
 
+        after = await scrape_metrics(gw)
+        prom = {}
+        for family, short in (("llmlb_gateway_ttft_seconds", "ttft"),
+                              ("llmlb_gateway_e2e_seconds", "e2e"),
+                              ("llmlb_gateway_queue_wait_seconds",
+                               "queue_wait")):
+            for p in (50, 99):
+                v = delta_percentile(before[family], after[family], p)
+                prom[f"{short}_p{p}_ms"] = (round(v * 1000, 3)
+                                            if v is not None else None)
+
         latencies.sort()
 
         def pct(p: float) -> float:
@@ -89,6 +169,7 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
             "p50_ms": round(1000 * pct(0.50), 2),
             "p90_ms": round(1000 * pct(0.90), 2),
             "p99_ms": round(1000 * pct(0.99), 2),
+            "prometheus": prom,
             "native_router": gw.state.load_manager.stats().get(
                 "native_router", False
             ),

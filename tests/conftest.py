@@ -11,10 +11,20 @@ import os
 # plugin via PYTHONPATH sitecustomize that overrides JAX_PLATFORMS. jax.config
 # updates before first backend use win over it.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Older jax (< 0.5) has no jax_num_cpu_devices config; the XLA flag is the
+# portable spelling and must be in place before the backend initializes.
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # jax < 0.5: XLA_FLAGS above already did it
+    pass
 # fp32 tests compare against float64/torch references; JAX's default ("fastest")
 # matmul precision is bf16-grade even on CPU.
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -5,8 +5,23 @@ the Mixtral-class sharded step across a real 2-process jax.distributed
 cluster (CPU simulation; process boundary = DCN slice).
 """
 
+import pytest
+
 from llmlb_tpu.parallel.distributed import build_hybrid_mesh, run_multihost_selftest
 from llmlb_tpu.parallel.mesh import MeshConfig
+
+
+def _selftest_or_skip(**kwargs):
+    """Environment gate: some jaxlib builds cannot run cross-process
+    collectives on the CPU backend at all (multihost_utils raises
+    INVALID_ARGUMENT inside the worker). Skip on exactly that signature so
+    every other worker failure still fails the test."""
+    try:
+        return run_multihost_selftest(**kwargs)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+        raise
 
 
 def test_hybrid_mesh_single_slice_axes():
@@ -18,7 +33,7 @@ def test_hybrid_mesh_single_slice_axes():
 
 
 def test_two_host_cluster_runs_sharded_moe_step():
-    run_multihost_selftest(num_hosts=2, devices_per_host=4)
+    _selftest_or_skip(num_hosts=2, devices_per_host=4)
 
 
 def test_lockstep_engine_across_two_hosts_matches_single_host():
@@ -43,7 +58,7 @@ def test_lockstep_engine_across_two_hosts_matches_single_host():
     finally:
         core.stop()
 
-    distributed = run_multihost_selftest(
+    distributed = _selftest_or_skip(
         num_hosts=2, devices_per_host=4, mode="--engine-worker"
     )
     assert distributed == baseline, (distributed, baseline)

@@ -43,6 +43,7 @@ class MockOpenAIEndpoint:
         self.fail_with = fail_with
         self.include_usage = include_usage
         self.requests_seen: list[dict] = []
+        self.headers_seen: list[dict] = []  # per-request inbound headers
         self.server: TestServer | None = None
 
     @property
@@ -73,6 +74,7 @@ class MockOpenAIEndpoint:
     async def _chat(self, request):
         body = await request.json()
         self.requests_seen.append(body)
+        self.headers_seen.append(dict(request.headers))
         if self.fail_with:
             return web.json_response({"error": "induced"}, status=self.fail_with)
         if self.reply_delay_s:
