@@ -81,6 +81,17 @@ class EngineMetrics:
         self.prefill_step = Histogram(STEP_BUCKETS)
         self.decode_step = Histogram(STEP_BUCKETS)
         self.batch_occupancy = 0
+        # Prefix KV cache (engine/prefix_cache.py): hit/miss per insert,
+        # prompt tokens served from cached KV instead of prefill compute,
+        # donor-slot insertions/evictions. The pinned-state gauges (entries,
+        # slots, HBM bytes) are scraped live from the scheduler at render
+        # time — they are state, not events.
+        self.prefix_hits_total = 0
+        self.prefix_misses_total = 0
+        self.prefix_cached_tokens_total = 0
+        self.prefix_insertions_total = 0
+        self.prefix_inserted_tokens_total = 0
+        self.prefix_evictions_total = 0
 
     # ------------------------------------------------------------ recorders
 
@@ -117,6 +128,26 @@ class EngineMetrics:
         with self._lock:
             self.batch_occupancy = active_slots
 
+    def record_prefix_hit(self, cached_tokens: int) -> None:
+        """One cache-hit insert serving `cached_tokens` prompt tokens from
+        copied KV rows instead of prefill."""
+        with self._lock:
+            self.prefix_hits_total += 1
+            self.prefix_cached_tokens_total += cached_tokens
+
+    def record_prefix_miss(self) -> None:
+        with self._lock:
+            self.prefix_misses_total += 1
+
+    def record_prefix_insert(self, tokens: int) -> None:
+        with self._lock:
+            self.prefix_insertions_total += 1
+            self.prefix_inserted_tokens_total += tokens
+
+    def record_prefix_eviction(self) -> None:
+        with self._lock:
+            self.prefix_evictions_total += 1
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -140,11 +171,17 @@ class EngineMetrics:
                 "ttft_p99_s": self.ttft.percentile(99),
                 "itl_p50_s": self.itl.percentile(50),
                 "itl_p99_s": self.itl.percentile(99),
+                "prefix_hits_total": self.prefix_hits_total,
+                "prefix_misses_total": self.prefix_misses_total,
+                "prefix_cached_tokens_total": self.prefix_cached_tokens_total,
+                "prefix_evictions_total": self.prefix_evictions_total,
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
-               num_slots: int) -> str:
-        """Prometheus text exposition format."""
+               num_slots: int, prefix_cache: dict | None = None) -> str:
+        """Prometheus text exposition format. `prefix_cache` is the
+        scheduler's prefix_cache_info() block (pinned-state gauges live
+        there; the event counters live here)."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -163,7 +200,37 @@ class EngineMetrics:
                 f"llmlb_engine_num_slots {num_slots}",
                 "# TYPE llmlb_engine_batch_occupancy gauge",
                 f"llmlb_engine_batch_occupancy {self.batch_occupancy}",
+                "# TYPE llmlb_engine_prefix_cache_hits_total counter",
+                f"llmlb_engine_prefix_cache_hits_total {self.prefix_hits_total}",
+                "# TYPE llmlb_engine_prefix_cache_misses_total counter",
+                "llmlb_engine_prefix_cache_misses_total "
+                f"{self.prefix_misses_total}",
+                "# TYPE llmlb_engine_prefix_cache_cached_tokens_total counter",
+                "llmlb_engine_prefix_cache_cached_tokens_total "
+                f"{self.prefix_cached_tokens_total}",
+                "# TYPE llmlb_engine_prefix_cache_insertions_total counter",
+                "llmlb_engine_prefix_cache_insertions_total "
+                f"{self.prefix_insertions_total}",
+                "# TYPE llmlb_engine_prefix_cache_inserted_tokens_total "
+                "counter",
+                "llmlb_engine_prefix_cache_inserted_tokens_total "
+                f"{self.prefix_inserted_tokens_total}",
+                "# TYPE llmlb_engine_prefix_cache_evictions_total counter",
+                "llmlb_engine_prefix_cache_evictions_total "
+                f"{self.prefix_evictions_total}",
             ]
+            if prefix_cache is not None and prefix_cache.get("enabled"):
+                lines += [
+                    "# TYPE llmlb_engine_prefix_cache_entries gauge",
+                    "llmlb_engine_prefix_cache_entries "
+                    f"{prefix_cache['entries']}",
+                    "# TYPE llmlb_engine_prefix_cache_pinned_slots gauge",
+                    "llmlb_engine_prefix_cache_pinned_slots "
+                    f"{prefix_cache['pinned_slots']}",
+                    "# TYPE llmlb_engine_prefix_cache_pinned_hbm_bytes gauge",
+                    "llmlb_engine_prefix_cache_pinned_hbm_bytes "
+                    f"{prefix_cache['pinned_hbm_bytes']}",
+                ]
             for name, hist in (
                 ("llmlb_engine_ttft_seconds", self.ttft),
                 ("llmlb_engine_itl_seconds", self.itl),

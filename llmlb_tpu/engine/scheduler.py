@@ -10,6 +10,10 @@ JetStream-style serving loop, TPU-first:
 - Sampling params live in device arrays indexed by slot; updated on insert.
 - The step loop runs in a dedicated thread; completions stream to waiters
   through per-request queues (asyncio- and thread-friendly).
+- Prefix KV reuse (engine/prefix_cache.py): completed requests donate their
+  slot to a refcounted radix tree keyed on prompt token ids; a later request
+  sharing a prefix copies the cached rows with one device-side slice
+  (no recompute) and chunk-prefills only the uncached suffix.
 
 The reference has no equivalent (it proxies to external runtimes, SURVEY.md L0);
 this is the in-tree `tpu://` engine of the BASELINE.json north star.
@@ -33,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from llmlb_tpu.engine.metrics import EngineMetrics
+from llmlb_tpu.engine.prefix_cache import PrefixCache, PrefixEntry
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
@@ -65,6 +70,29 @@ def _scatter_kv_row(cache_k, cache_v, k_all, v_all, slot_id):
     return (
         jax.lax.dynamic_update_slice(cache_k, k_all.astype(cache_k.dtype), start),
         jax.lax.dynamic_update_slice(cache_v, v_all.astype(cache_v.dtype), start),
+    )
+
+
+@partial(jax.jit, donate_argnames=("cache_k", "cache_v"),
+         static_argnames=("rows",))
+def _copy_kv_prefix(cache_k, cache_v, src_slot, dst_slot, rows):
+    """Prefix-cache hit: copy the first `rows` KV rows of pinned donor row
+    `src_slot` into target row `dst_slot` — one device-side
+    dynamic_update_slice per cache, no recompute, no host round trip.
+    `rows` is static (the caller pads the matched length to the next power
+    of two, bounding the jit cache at log2(capacity) variants); rows copied
+    beyond the matched prefix are overwritten by the suffix prefill or sit
+    past the valid length where every attention masks them."""
+    zero = jnp.int32(0)
+    layers, _, _, kv_heads, head_dim = cache_k.shape
+    size = (layers, 1, rows, kv_heads, head_dim)
+    src = (zero, src_slot, zero, zero, zero)
+    dst = (zero, dst_slot, zero, zero, zero)
+    blk_k = jax.lax.dynamic_slice(cache_k, src, size)
+    blk_v = jax.lax.dynamic_slice(cache_v, src, size)
+    return (
+        jax.lax.dynamic_update_slice(cache_k, blk_k, dst),
+        jax.lax.dynamic_update_slice(cache_v, blk_v, dst),
     )
 
 
@@ -106,6 +134,10 @@ class _Slot:
     # the chunks are filling.
     prefilling: bool = False
     prefill_pos: int = 0
+    # Prefix-cache entry this slot is reading (hit path): acquired for the
+    # suffix prefill so the donor cannot be evicted mid-copy-window; released
+    # on activation, cancellation, or engine failure.
+    cache_entry: PrefixEntry | None = None
     last_emit_at: float = 0.0  # inter-token latency tracking
     # The first token is sampled on-device at activation and emitted with the
     # NEXT decode fetch instead of its own host readback — per-insert syncs
@@ -139,6 +171,9 @@ class EngineCore:
         eos_id: int = -1,
         seed: int = 0,
         decode_burst: int | None = None,
+        prefix_cache: bool | None = None,
+        prefix_cache_slots: int | None = None,
+        min_prefix_len: int | None = None,
     ):
         self.cfg = cfg
         # Family module (llama / mixtral) supplying the serving fns — one
@@ -150,6 +185,34 @@ class EngineCore:
             b for b in sorted(prefill_buckets) if b <= self.slot_capacity
         )
         self.eos_id = eos_id
+
+        # Prefix KV cache: completed requests may donate their slot to a
+        # radix tree keyed on prompt token ids; later requests sharing a
+        # prefix copy the cached rows device-side and prefill only the
+        # suffix. Disabled (None) the scheduler behaves exactly as before —
+        # every new branch below is gated on `self.prefix_cache is not None`.
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "LLMLB_PREFIX_CACHE", "1"
+            ).lower() not in ("0", "false", "off", "no")
+        # Matched lengths are aligned DOWN to the smallest prefill bucket so
+        # the uncached suffix always starts on a bucket boundary (chunked
+        # prefill then runs at its existing compiled sizes).
+        self.prefix_align = self.prefill_buckets[0] if self.prefill_buckets else 0
+        self.min_prefix_len = (
+            max(1, int(min_prefix_len)) if min_prefix_len is not None
+            else self.prefix_align
+        )
+        if prefix_cache_slots is None:
+            prefix_cache_slots = max(1, num_slots // 2)
+        # pinned donors must always leave at least one slot serving traffic
+        budget = max(0, min(int(prefix_cache_slots), num_slots - 1))
+        self.prefix_cache: PrefixCache | None = (
+            PrefixCache(max_entries=budget, min_len=self.min_prefix_len,
+                        align=self.prefix_align)
+            if prefix_cache and budget > 0 and self.prefix_align > 0
+            else None
+        )
 
         devices = jax.devices()
         if mesh_config is None:
@@ -399,10 +462,17 @@ class EngineCore:
 
     def stats(self) -> EngineStats:
         active = sum(1 for s in self.slots if s.request is not None)
+        queued = self.pending.qsize()
+        if self.coordinator is not None:
+            # Multihost: requests sitting in the leader's intake queue or
+            # spilled to the next tick's plan backlog are queued work the
+            # gateway's telemetry-aware placement must see (reading only
+            # self.pending undercounted them).
+            queued += self._intake.qsize() + len(self._plan_backlog)
         return EngineStats(
             num_slots=self.num_slots,
             active_slots=active,
-            queued=self.pending.qsize(),
+            queued=queued,
             total_requests=self.total_requests,
             total_tokens=self.total_tokens,
             uptime_s=time.monotonic() - self._started_at,
@@ -465,7 +535,11 @@ class EngineCore:
             new.append(req)
         cancelled = []
         in_flight = [s.request for s in self.slots if s.request is not None]
-        in_flight += list(self.pending.queue)
+        # snapshot under the queue's own mutex — iterating .queue while a
+        # concurrent put() mutates the deque is undefined; the lock makes the
+        # snapshot atomic regardless of which thread produces into pending
+        with self.pending.mutex:
+            in_flight += list(self.pending.queue)
         for req in in_flight:
             if req.cancelled and req.request_id not in self._cancelled_effective:
                 cancelled.append(req.request_id)
@@ -555,6 +629,9 @@ class EngineCore:
         self._seq_lens[:] = 0
         self._d_seq_lens = jnp.zeros((self.num_slots,), jnp.int32)
         self._d_last_tokens = jnp.zeros((self.num_slots,), jnp.int32)
+        if self.prefix_cache is not None:
+            # the rebuilt cache holds zeros; every pinned prefix is gone
+            self.prefix_cache.clear()
 
     # Same-bucket pending prompts prefill TOGETHER in one dispatch (padded to
     # a power-of-two group so the jit cache stays at log2 sizes). Bounded so
@@ -562,8 +639,23 @@ class EngineCore:
     # prefill; the loop comes back around for the rest.
     MAX_PREFILL_GROUP = 8
 
+    def _free_slots(self) -> list[int]:
+        """Slots available for new requests: unoccupied and not pinned as
+        prefix-cache donors."""
+        pinned = (self.prefix_cache.pinned_slots()
+                  if self.prefix_cache is not None else ())
+        return [
+            i for i, s in enumerate(self.slots)
+            if s.request is None and i not in pinned
+        ]
+
     def _try_insert(self) -> bool:
-        free = [i for i, s in enumerate(self.slots) if s.request is None]
+        free = self._free_slots()
+        if not free and self.prefix_cache is not None and len(self.prefix_cache):
+            # Slot pressure: live traffic beats cached prefixes. Evict the
+            # LRU donor so a queued request is never starved by the cache.
+            if self.pending.qsize() > 0 and self._evict_one_prefix():
+                free = self._free_slots()
         if not free:
             return False
         max_oneshot = self.prefill_buckets[-1] if self.prefill_buckets else 0
@@ -590,6 +682,22 @@ class EngineCore:
                 self.metrics.record_request_done("error")
                 handled = True
                 continue
+            # Prompts that cannot possibly match (too short for min_prefix_len
+            # after reserving one suffix token) bypass the cache silently —
+            # counting them as misses would page the hit-rate-collapse alert
+            # on workloads with nothing cacheable in them.
+            if (self.prefix_cache is not None
+                    and n - 1 >= self.min_prefix_len):
+                # Longest cached prefix, capped at n-1 (at least one suffix
+                # token must prefill to produce the first sampled logits).
+                hit = self.prefix_cache.match(request.prompt_ids,
+                                             max_len=n - 1)
+                if hit is not None and not self._prefer_cp_over(hit[1], n):
+                    self._insert_cached(free.pop(0), request, hit[0], hit[1])
+                    handled = True
+                    inserted += 1
+                    continue
+                self.metrics.record_prefix_miss()
             slot_id = free.pop(0)
             if n > max_oneshot:
                 heavy = self._insert_long(slot_id, request, n)
@@ -643,6 +751,113 @@ class EngineCore:
             self.slot_capacity - 1
         )
         return False
+
+    # ----------------------------------------------------------- prefix cache
+
+    def _prefer_cp_over(self, use_len: int, n: int) -> bool:
+        """On a context-parallel mesh (sp > 1), a long prompt prefills in ONE
+        distributed ring-attention pass (~n/sp per chip), while a cache hit
+        routes the suffix through sequential single-chip chunks. A small hit
+        on a huge prompt would make the request slower than a clean miss —
+        only take the hit when the cache covers at least half the prompt."""
+        return (
+            self._use_cp_prefill
+            and hasattr(self.family, "make_context_parallel_prefill")
+            and n > (self.prefill_buckets[-1] if self.prefill_buckets else 0)
+            and use_len < n // 2
+        )
+
+    def _insert_cached(self, slot_id: int, request: Request,
+                       entry: PrefixEntry, use_len: int) -> None:
+        """Prefix-cache hit insert: copy `use_len` cached KV rows from the
+        donor slot into `slot_id` on device, then let _advance_prefill
+        chunk-prefill only the uncached suffix (prefill_pos starts at
+        use_len). The entry stays acquired until activation/cancellation so
+        its donor slot cannot be evicted and reused mid-flight."""
+        # Claim the slot BEFORE the copy dispatch (same invariant as the
+        # batch path): a failed dispatch then reaches this request through
+        # _fail_all — which also releases cache_entry — instead of leaving
+        # its event queue silent forever.
+        slot = self.slots[slot_id]
+        slot.request = request
+        slot.generated = 0
+        slot.prefilling = True
+        slot.prefill_pos = use_len
+        slot.cache_entry = entry
+        self.prefix_cache.acquire(entry)
+        self._seq_lens[slot_id] = 0
+        # park device seq_len like any prefilling slot: batched decode's
+        # garbage writes land in the unused last cell
+        self._d_seq_lens = self._d_seq_lens.at[slot_id].set(
+            self.slot_capacity - 1
+        )
+        rows = 1
+        while rows < use_len:
+            rows *= 2
+        rows = min(rows, self.slot_capacity)
+        self.cache_k, self.cache_v = _copy_kv_prefix(
+            self.cache_k, self.cache_v,
+            jnp.int32(entry.slot), jnp.int32(slot_id), rows,
+        )
+        self.metrics.record_prefix_hit(use_len)
+
+    def _release_cache_entry(self, slot: _Slot) -> None:
+        if slot.cache_entry is not None:
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(slot.cache_entry)
+            slot.cache_entry = None
+
+    def _evict_one_prefix(self) -> bool:
+        freed = self.prefix_cache.evict_lru()
+        if freed is None:
+            return False  # every donor has an in-flight reader
+        self.metrics.record_prefix_eviction()
+        return True
+
+    def _maybe_cache_prefix(self, slot_id: int, request: Request) -> None:
+        """On request completion: pin this slot as a prefix donor when the
+        prompt's bucket-aligned head is long enough and not already covered.
+        The slot is NOT freed on success — _free_slots excludes pinned donors
+        until eviction returns them."""
+        cache = self.prefix_cache
+        n = len(request.prompt_ids)
+        length = (n // cache.align) * cache.align
+        if length < cache.min_len:
+            return
+        tokens = tuple(request.prompt_ids[:length])
+        if cache.covers(tokens):
+            cache.touch(tokens)  # a re-served prefix is a use: refresh LRU
+            return
+        # A longer prefix subsumes its ancestors (any match they could serve
+        # routes through this entry's subtree) — reclaim their donor slots
+        # first, or each turn of a growing conversation pins a fresh slot.
+        # NOT counted as evictions: coverage is preserved, and on healthy
+        # multi-turn traffic this fires once per turn — charging it to
+        # evictions_total would make the donor-churn signal operators alert
+        # on track plain insertion rate.
+        cache.evict_subsumed(tokens)
+        if len(cache) >= cache.max_entries and not self._evict_one_prefix():
+            return
+        if cache.insert(tokens, slot_id) is not None:
+            self.metrics.record_prefix_insert(length)
+
+    def prefix_cache_info(self) -> dict:
+        """One JSON-safe block for /api/health, /api/system, and /metrics."""
+        if self.prefix_cache is None:
+            return {"enabled": False}
+        pinned = len(self.prefix_cache)
+        # a pinned donor holds its whole slot row out of the serving pool
+        slot_bytes = kv_cache_bytes(self.cfg, 1, self.slot_capacity)
+        return {
+            "enabled": True,
+            "entries": pinned,
+            "pinned_slots": pinned,
+            "budget_slots": self.prefix_cache.max_entries,
+            "cached_tokens": self.prefix_cache.cached_tokens(),
+            "pinned_hbm_bytes": pinned * slot_bytes,
+            "min_prefix_len": self.min_prefix_len,
+            "align": self.prefix_align,
+        }
 
     def _prefill_group(self, bucket: int,
                        group: list[tuple[int, Request, int]]) -> None:
@@ -779,6 +994,7 @@ class EngineCore:
             request.events.put(("done", "cancelled"))
             self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
+            self._release_cache_entry(slot)
             slot.request = None
             slot.prefilling = False
             slot.generated = 0
@@ -810,6 +1026,7 @@ class EngineCore:
         slot.prefill_pos = start + chunk_len
         if slot.prefill_pos >= n:
             slot.prefilling = False
+            self._release_cache_entry(slot)  # suffix landed; donor evictable
             self._activate_slot(slot_id, request, n, logits)
         return True
 
@@ -1004,6 +1221,12 @@ class EngineCore:
             request.finished_at = time.monotonic()
             request.events.put(("done", finish))
             self.metrics.record_request_done(finish)
+            if self.prefix_cache is not None:
+                # Donor retention: the freed slot's rows [0, prompt_len) hold
+                # exactly the prompt's KV — pin them for prefix reuse instead
+                # of discarding (the slot stays out of the free pool until
+                # evicted LRU or under slot pressure).
+                self._maybe_cache_prefix(slot_id, request)
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
@@ -1015,6 +1238,7 @@ class EngineCore:
                 slot.request.events.put(("error", message))
                 self.metrics.record_request_done("error")
                 slot.request = None
+            self._release_cache_entry(slot)
             slot.prefilling = False
             slot.prefill_pos = 0
             slot.generated = 0

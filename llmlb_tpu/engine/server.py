@@ -263,7 +263,7 @@ class EngineAPI:
         stats = core.stats()
         text = core.metrics.render(
             queue_depth=stats.queued, active_slots=stats.active_slots,
-            num_slots=stats.num_slots,
+            num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -276,6 +276,7 @@ class EngineAPI:
                 "version": __version__,
                 "tpu_engine": True,
                 "model": self.engine.model_id,
+                "prefix_cache": self.engine.core.prefix_cache_info(),
             }
         )
 
@@ -733,6 +734,21 @@ def main(argv: list[str] | None = None) -> None:
         help="decode+sample steps fused per device dispatch (default: "
              "8 on TPU, 1 elsewhere; also via LLMLB_DECODE_BURST)",
     )
+    parser.add_argument(
+        "--prefix-cache", choices=("on", "off"), default=None,
+        help="radix-tree prefix KV reuse across requests (default on; "
+             "also via LLMLB_PREFIX_CACHE=0)",
+    )
+    parser.add_argument(
+        "--prefix-cache-slots", type=int, default=None,
+        help="max decode slots pinned as prefix donors "
+             "(default num_slots // 2, always leaving one serving slot)",
+    )
+    parser.add_argument(
+        "--min-prefix-len", type=int, default=None,
+        help="shortest prompt prefix worth caching, in tokens "
+             "(default: the smallest prefill bucket)",
+    )
     # modality services (checkpoint dir, or "random" for test weights)
     parser.add_argument("--asr", default=None,
                         help="whisper checkpoint dir or 'random'")
@@ -757,6 +773,12 @@ def main(argv: list[str] | None = None) -> None:
         extra["prefill_buckets"] = buckets
     if args.decode_burst is not None:
         extra["decode_burst"] = max(1, args.decode_burst)
+    if args.prefix_cache is not None:
+        extra["prefix_cache"] = args.prefix_cache == "on"
+    if args.prefix_cache_slots is not None:
+        extra["prefix_cache_slots"] = max(0, args.prefix_cache_slots)
+    if args.min_prefix_len is not None:
+        extra["min_prefix_len"] = max(1, args.min_prefix_len)
 
     logging.basicConfig(level=logging.INFO)
     # Multi-host bring-up must precede the first jax backend use (engine

@@ -304,6 +304,7 @@ class Engine:
                 "mesh": dict(self.core.mesh.shape),
             },
             "tpu": device_telemetry(),
+            "prefix_cache": self.core.prefix_cache_info(),
             "metrics": self.core.metrics.summary(),
         }
 

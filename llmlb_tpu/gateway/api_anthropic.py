@@ -24,9 +24,11 @@ from aiohttp import web
 from llmlb_tpu.gateway.api_openai import (
     QueueTimeout,
     _record,
+    affinity_text_from_body,
     error_response,
     select_endpoint_with_queue,
 )
+from llmlb_tpu.gateway.balancer import prefix_affinity_hash
 from llmlb_tpu.gateway.model_names import to_canonical
 from llmlb_tpu.gateway.token_accounting import estimate_tokens
 from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
@@ -363,6 +365,9 @@ async def messages(request: web.Request) -> web.StreamResponse:
         selection = await select_endpoint_with_queue(
             state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
             trace=trace,
+            prefix_hash=prefix_affinity_hash(
+                canonical, affinity_text_from_body(body)
+            ),
         )
     except QueueTimeout:
         return _anthropic_error(503, "all endpoints busy", "overloaded_error")

@@ -21,7 +21,7 @@ import aiohttp
 from aiohttp import web
 
 from llmlb_tpu.gateway.app_state import AppState, record_daily_stat
-from llmlb_tpu.gateway.balancer import RequestRecord
+from llmlb_tpu.gateway.balancer import RequestRecord, prefix_affinity_hash
 from llmlb_tpu.gateway.model_names import to_canonical, to_engine_name
 from llmlb_tpu.gateway.sanitize import sanitize_request_body
 from llmlb_tpu.gateway.token_accounting import (
@@ -52,15 +52,61 @@ def parse_cloud_prefix(model: str) -> tuple[str | None, str]:
     return None, model
 
 
+def affinity_text_from_body(body: dict) -> str:
+    """The prompt head used for prefix-affinity hashing: the request's
+    LEADING SHARED BLOCK — explicit instructions/system when present,
+    otherwise the first message (or the prompt/input string). The varying
+    tail (this turn's user message) must stay out of the hash, or a short
+    system prompt with per-request questions would hash every request
+    differently and spray one warm prefix across the fleet. The hash
+    itself caps the text at PREFIX_AFFINITY_CHARS, which also keeps long
+    multi-turn histories hashing stably turn over turn. Best-effort —
+    unknown shapes hash to nothing and simply skip affinity."""
+    def text_of(content) -> str:
+        if isinstance(content, str):
+            return content
+        if isinstance(content, list):  # multimodal / typed content blocks
+            return "\n".join(
+                b["text"] for b in content
+                if isinstance(b, dict) and isinstance(b.get("text"), str)
+            )
+        return ""
+
+    if isinstance(body.get("instructions"), str):  # responses API
+        return body["instructions"]
+    if body.get("system") is not None:  # anthropic: string or block list
+        system = text_of(body["system"])
+        if system:
+            return system
+    msgs = body.get("messages") or body.get("input")
+    if isinstance(msgs, list):
+        for m in msgs:
+            if isinstance(m, dict):
+                text = text_of(m.get("content"))
+                if text:
+                    return f"{m.get('role', 'user')}:{text}"
+        return ""
+    if isinstance(msgs, str):
+        return msgs
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+        return prompt[0]
+    return ""
+
+
 async def select_endpoint_with_queue(
     state: AppState, model: str, capability: Capability, api_kind: TpsApiKind,
-    trace=None,
+    trace=None, prefix_hash: str | None = None,
 ) -> tuple[Endpoint, str, "RequestLease"] | None:
     """Atomically TPS-select and lease an endpoint serving the model; if all
     are at the admission cap, park on the AdmissionQueue until a lease release
     wakes us or the queue timeout passes (notify-based, no polling — parity:
-    balancer/mod.rs:2273-2427). Records admission/queue_wait/endpoint_select
-    spans on `trace` and feeds the gateway queue-wait histogram."""
+    balancer/mod.rs:2273-2427). `prefix_hash` steers toward the endpoint
+    whose engine-side prefix KV cache is warm for this prompt. Records
+    admission/queue_wait/endpoint_select spans on `trace` and feeds the
+    gateway queue-wait histogram."""
     if not state.registry.find_by_model(model, capability):
         return None
 
@@ -70,7 +116,8 @@ async def select_endpoint_with_queue(
     if trace is not None:
         trace.begin("admission")
     admit_start = time.monotonic()
-    result = await state.admission.admit(get_endpoints, model, api_kind)
+    result = await state.admission.admit(get_endpoints, model, api_kind,
+                                         prefix_hash=prefix_hash)
     if not result.admitted:
         state.metrics.record_queue_timeout(model)
         state.metrics.record_queue_wait(model, "none", result.waited_s)
@@ -176,9 +223,18 @@ async def proxy_openai_post(
     canonical = to_canonical(model)
     if trace is not None:
         trace.model = canonical
+    # Affinity only for generation traffic: embeddings (and other non-chat
+    # capabilities) never touch the engine's prefix KV cache, and hashing
+    # their inputs would churn the shared affinity map and pin their routing
+    # for zero benefit.
+    prefix_hash = (
+        prefix_affinity_hash(canonical, affinity_text_from_body(body))
+        if capability == Capability.CHAT_COMPLETION else None
+    )
     try:
         selection = await select_endpoint_with_queue(
-            state, canonical, capability, api_kind, trace=trace
+            state, canonical, capability, api_kind, trace=trace,
+            prefix_hash=prefix_hash,
         )
     except QueueTimeout as qt:
         return error_response(

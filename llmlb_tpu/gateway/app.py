@@ -519,10 +519,17 @@ async def _gateway_metrics(request: web.Request) -> web.Response:
     TTFT, e2e, and queue-wait histograms, per-route counters, plus
     scrape-time gauges owned by the balancer and event bus."""
     state: AppState = request.app["state"]
+    affinity = state.load_manager.affinity_stats()
     text = state.metrics.render(
         counters={
             "llmlb_gateway_dropped_events_total":
                 state.events.dropped_events_total(),
+            "llmlb_gateway_prefix_affinity_hits_total":
+                affinity["hits_total"],
+            "llmlb_gateway_prefix_affinity_misses_total":
+                affinity["misses_total"],
+            "llmlb_gateway_prefix_affinity_evictions_total":
+                affinity["evictions_total"],
         },
         gauges={
             "llmlb_gateway_active_requests":
@@ -530,6 +537,7 @@ async def _gateway_metrics(request: web.Request) -> web.Response:
             "llmlb_gateway_admission_queue_depth":
                 state.admission.queue_depth(),
             "llmlb_gateway_traces_buffered": len(state.traces),
+            "llmlb_gateway_prefix_affinity_entries": affinity["entries"],
         },
     )
     return web.Response(text=text, content_type="text/plain", charset="utf-8")

@@ -16,17 +16,23 @@ Behavior parity with the reference scheduler (reference balancer/mod.rs):
   and a non-empty engine admission queue divides it by (1 + depth). Unmeasured
   endpoints still probe first, but telemetry breaks ties among them before
   round-robin does.
+- Prefix-affinity routing (no reference counterpart): requests whose prompt
+  head hashes to a recently-routed prefix stick to the endpoint that last
+  served it, so the engine-side prefix KV cache (engine/prefix_cache.py)
+  actually gets hit; bounded LRU map with TTL, falls back to normal scoring
+  whenever the sticky endpoint is unhealthy, absent, or at its cap.
 """
 
 from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
 import os
 import threading
 import time
 import typing
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 
 from llmlb_tpu.gateway.config import QueueConfig
 from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
@@ -40,6 +46,36 @@ METRICS_STALE_S = 120.0
 # engine near HBM capacity will soon reject or thrash; prefer its peers.
 HBM_PRESSURE_KNEE = 0.85
 TELEMETRY_MIN_PENALTY = 0.05
+
+# Prefix-affinity routing: the tpu:// engine keeps a prefix KV cache
+# (engine/prefix_cache.py), so two requests sharing a system prompt are far
+# cheaper on the SAME engine than split across two. The gateway hashes the
+# head of each prompt and remembers which endpoint last served that hash;
+# the next request with the same hash is steered there as long as the
+# endpoint is a live candidate under its admission cap — otherwise selection
+# falls back to the normal TPS/telemetry scoring and the hash is re-pinned
+# to whatever endpoint wins. The map is bounded (LRU) and entries expire,
+# so a dead prefix never pins routing forever.
+PREFIX_AFFINITY_CAPACITY = 4096
+PREFIX_AFFINITY_TTL_S = 600.0
+PREFIX_AFFINITY_CHARS = 512  # ≈ the first 128 prompt tokens
+# Heads shorter than this can never clear the engine's minimum cacheable
+# prefix (the smallest prefill bucket — 32 tokens ≈ 128 chars on the default
+# config), so pinning them would override TPS/telemetry placement for zero
+# cache benefit — short prompts keep the old scoring.
+PREFIX_AFFINITY_MIN_CHARS = 128
+
+
+def prefix_affinity_hash(model: str, text: str) -> str | None:
+    """Stable hash of a prompt's head (+ model, so two models' identical
+    system prompts don't collide onto one engine's cache). None for heads
+    too short to benefit from prefix reuse."""
+    if len(text) < PREFIX_AFFINITY_MIN_CHARS:
+        return None
+    head = text[:PREFIX_AFFINITY_CHARS]
+    return hashlib.sha1(
+        f"{model}\x00{head}".encode("utf-8", "replace")
+    ).hexdigest()
 
 
 def telemetry_penalty(ep: Endpoint, now: float | None = None) -> float:
@@ -151,6 +187,13 @@ class LoadManager:
         self._rr_counter: dict[str, int] = defaultdict(int)  # round-robin per model
         self._history: deque[RequestRecord] = deque()
         self._total_requests = 0
+        # (model, prefix_hash) -> (endpoint_id, recorded_at); bounded LRU
+        self._affinity: OrderedDict[tuple[str, str], tuple[str, float]] = (
+            OrderedDict()
+        )
+        self._affinity_hits = 0
+        self._affinity_misses = 0
+        self._affinity_evictions = 0
         # Called (outside the lock) with the endpoint id each time a lease is
         # released — the AdmissionQueue uses it to wake parked waiters instead
         # of having them poll (parity: balancer/mod.rs:2273-2427 notify path).
@@ -210,7 +253,14 @@ class LoadManager:
             return state.ema_tps if state and state.samples else None
 
     def clear_tps_for_endpoint(self, endpoint_id: str) -> None:
-        """On failure: a recovered endpoint must re-learn (balancer/mod.rs:1791)."""
+        """On failure: a recovered endpoint must re-learn (balancer/mod.rs:1791).
+        Prefix affinities pinned to it are dropped too — its engine restarts
+        with a cold prefix cache, so stickiness buys nothing and would keep
+        steering shared-prefix traffic at a flapping endpoint."""
+        with self._lock:
+            for key in [k for k, (eid, _) in self._affinity.items()
+                        if eid == endpoint_id]:
+                del self._affinity[key]
         if self._rc is not None:
             self._rc.clear_endpoint(endpoint_id)
             return
@@ -232,6 +282,56 @@ class LoadManager:
                 for (eid, model, kind), s in self._tps.items()
             }
 
+    # ------------------------------------------------------- prefix affinity
+
+    def _affinity_peek_locked(self, model: str, prefix_hash: str) -> str | None:
+        key = (model, prefix_hash)
+        got = self._affinity.get(key)
+        if got is None:
+            return None
+        endpoint_id, ts = got
+        if time.time() - ts > PREFIX_AFFINITY_TTL_S:
+            del self._affinity[key]
+            return None
+        return endpoint_id
+
+    def _affinity_note_locked(self, model: str, prefix_hash: str,
+                              endpoint_id: str) -> None:
+        key = (model, prefix_hash)
+        self._affinity[key] = (endpoint_id, time.time())
+        self._affinity.move_to_end(key)
+        while len(self._affinity) > PREFIX_AFFINITY_CAPACITY:
+            self._affinity.popitem(last=False)
+            self._affinity_evictions += 1
+
+    def _affinity_endpoint(self, model: str,
+                           prefix_hash: str | None) -> str | None:
+        if prefix_hash is None:
+            return None
+        with self._lock:
+            return self._affinity_peek_locked(model, prefix_hash)
+
+    def _affinity_record(self, model: str, prefix_hash: str | None,
+                         endpoint_id: str, *, hit: bool) -> None:
+        if prefix_hash is None:
+            return
+        with self._lock:
+            self._affinity_note_locked(model, prefix_hash, endpoint_id)
+            if hit:
+                self._affinity_hits += 1
+            else:
+                self._affinity_misses += 1
+
+    def affinity_stats(self) -> dict:
+        """Prefix-affinity figures for the gateway /metrics exposition."""
+        with self._lock:
+            return {
+                "entries": len(self._affinity),
+                "hits_total": self._affinity_hits,
+                "misses_total": self._affinity_misses,
+                "evictions_total": self._affinity_evictions,
+            }
+
     # -------------------------------------------------------------- selection
 
     def select_endpoint(
@@ -239,17 +339,43 @@ class LoadManager:
         endpoints: list[Endpoint],
         model: str,
         api_kind: TpsApiKind = TpsApiKind.CHAT,
+        prefix_hash: str | None = None,
     ) -> Endpoint | None:
-        """Pick the best endpoint: telemetry-weighted measured-TPS desc;
-        unmeasured first (probe), telemetry then round-robin among equals;
-        full endpoints (admission cap) excluded."""
+        """Pick the best endpoint: prefix affinity first (the endpoint that
+        last served this prompt head, while it is a live candidate under its
+        cap), then telemetry-weighted measured-TPS desc; unmeasured first
+        (probe), telemetry then round-robin among equals; full endpoints
+        (admission cap) excluded."""
         if not endpoints:
             return None
         if self._rc is not None:
+            sticky = self._affinity_sticky_rc(endpoints, model, prefix_hash)
+            if sticky is not None:
+                return sticky
             idx = self._rc_select(endpoints, model, api_kind, admit=False)
-            return None if idx < 0 else endpoints[idx]
+            if idx < 0:
+                return None
+            self._affinity_record(model, prefix_hash, endpoints[idx].id,
+                                  hit=False)
+            return endpoints[idx]
         with self._lock:
-            return self._select_locked(endpoints, model, api_kind)
+            return self._select_locked(endpoints, model, api_kind,
+                                       prefix_hash)
+
+    def _affinity_sticky_rc(self, endpoints: list[Endpoint], model: str,
+                            prefix_hash: str | None) -> Endpoint | None:
+        """Native-router path: the affinity map lives on the Python side, so
+        steer before delegating to the C++ scorer. Only honors an endpoint
+        that is still a candidate and under its admission cap."""
+        eid = self._affinity_endpoint(model, prefix_hash)
+        if eid is None:
+            return None
+        cap = self.queue_config.max_active_per_endpoint
+        for ep in endpoints:
+            if ep.id == eid and self._rc.active(eid) < cap:
+                self._affinity_record(model, prefix_hash, eid, hit=True)
+                return ep
+        return None
 
     def _rc_select(self, endpoints: list[Endpoint], model: str,
                    api_kind: TpsApiKind, *, admit: bool) -> int:
@@ -263,7 +389,8 @@ class LoadManager:
         )
 
     def _select_locked(
-        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind
+        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind,
+        prefix_hash: str | None = None,
     ) -> Endpoint | None:
         cap = self.queue_config.max_active_per_endpoint
         candidates = [
@@ -271,6 +398,14 @@ class LoadManager:
         ]
         if not candidates:
             return None
+
+        if prefix_hash is not None:
+            sticky_id = self._affinity_peek_locked(model, prefix_hash)
+            for ep in candidates:
+                if ep.id == sticky_id:
+                    self._affinity_note_locked(model, prefix_hash, ep.id)
+                    self._affinity_hits += 1
+                    return ep
 
         now = time.time()
         scored: list[tuple[float, float, Endpoint]] = []
@@ -292,10 +427,15 @@ class LoadManager:
             top = [(pen, ep) for pen, ep in top if pen == best_pen]
         idx = self._rr_counter[model] % len(top)
         self._rr_counter[model] += 1
-        return top[idx][1]
+        chosen = top[idx][1]
+        if prefix_hash is not None:
+            self._affinity_note_locked(model, prefix_hash, chosen.id)
+            self._affinity_misses += 1
+        return chosen
 
     def try_admit(
-        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind
+        self, endpoints: list[Endpoint], model: str, api_kind: TpsApiKind,
+        prefix_hash: str | None = None,
     ) -> tuple[Endpoint, RequestLease] | None:
         """Atomic select + lease under one lock: concurrent admissions cannot
         both pick the last free slot of an endpoint (the select-then-begin
@@ -303,13 +443,30 @@ class LoadManager:
         if not endpoints:
             return None
         if self._rc is not None:
+            eid = self._affinity_endpoint(model, prefix_hash)
+            sticky = next((ep for ep in endpoints if ep.id == eid), None)
+            if sticky is not None:
+                # atomic cap-check + begin in the native core, scoped to the
+                # sticky endpoint alone; at-cap falls through to full scoring
+                got = self._rc.select(
+                    model, api_kind.value, [sticky.id],
+                    [telemetry_penalty(sticky)],
+                    self.queue_config.max_active_per_endpoint, True,
+                )
+                if got == 0:
+                    self._affinity_record(model, prefix_hash, sticky.id,
+                                          hit=True)
+                    return sticky, RequestLease(self, sticky.id, model,
+                                                api_kind)
             idx = self._rc_select(endpoints, model, api_kind, admit=True)
             if idx < 0:
                 return None
             chosen = endpoints[idx]
+            self._affinity_record(model, prefix_hash, chosen.id, hit=False)
             return chosen, RequestLease(self, chosen.id, model, api_kind)
         with self._lock:
-            chosen = self._select_locked(endpoints, model, api_kind)
+            chosen = self._select_locked(endpoints, model, api_kind,
+                                         prefix_hash)
             if chosen is None:
                 return None
             self._active[chosen.id] += 1
@@ -485,12 +642,16 @@ class AdmissionQueue:
         model: str,
         api_kind: TpsApiKind,
         timeout_s: float | None = None,
+        prefix_hash: str | None = None,
     ) -> WaitResult:
         """Admit onto the best endpoint, parking until a slot frees or the
         queue timeout passes. `get_endpoints` is re-invoked on every retry so
-        registry changes (recovered/added endpoints) are picked up."""
+        registry changes (recovered/added endpoints) are picked up.
+        `prefix_hash` biases selection toward the endpoint whose prefix KV
+        cache is warm for this prompt head."""
         start = time.monotonic()
-        got = self.manager.try_admit(get_endpoints(), model, api_kind)
+        got = self.manager.try_admit(get_endpoints(), model, api_kind,
+                                     prefix_hash)
         if got is not None:
             return WaitResult(admitted=True, endpoint=got[0], lease=got[1])
 
@@ -523,7 +684,8 @@ class AdmissionQueue:
                     pass  # fall through to retry; deadline checked at top
                 if self.metrics is not None:
                     self.metrics.record_retry(api_kind.value)
-                got = self.manager.try_admit(get_endpoints(), model, api_kind)
+                got = self.manager.try_admit(get_endpoints(), model, api_kind,
+                                             prefix_hash)
                 if got is not None:
                     return WaitResult(
                         admitted=True, endpoint=got[0], lease=got[1],

@@ -15,6 +15,17 @@ much gateway CPU one TPU engine's request rate can consume.
 The gateway's own /metrics is scraped before and after the timed window and
 the TTFT/E2E/queue-wait percentile deltas are printed under "prometheus", so
 bench output and the Prometheus view agree on one source of truth.
+
+A second mode measures the prefix KV cache end to end with a REAL in-process
+tpu:// engine (CPU backend) behind the gateway:
+
+    python scripts/bench_gateway.py --workload shared-prefix [--requests 24]
+
+Every request shares one long system prompt with a varying user tail — the
+production chat shape. The bench classifies each request hit/miss from the
+engine's own prefix counters and reports the hit rate, prefill tokens served
+from cache, and mean TTFT split by hit vs miss, alongside the engine
+/metrics exposition names so Prometheus shows the same story.
 """
 
 from __future__ import annotations
@@ -179,12 +190,131 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
         await gw.close()
 
 
+async def run_prefix_bench(requests: int) -> dict:
+    """Shared-prefix workload against a real tpu:// engine (CPU backend)
+    proxied through the full gateway: repeated system prompt, varying tails.
+    Sequential on purpose — each request is classified hit/miss from the
+    engine's prefix counters, so TTFT can be split by cache outcome."""
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+    from tests.support import GatewayHarness
+
+    engine = Engine.from_preset(
+        "debug-tiny", num_slots=4, slot_capacity=256,
+        prefill_buckets=(16, 32, 64),
+    )
+    eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+    await eng_server.start_server()
+    gw = await GatewayHarness.create()
+    try:
+        gw.register_mock(
+            f"http://127.0.0.1:{eng_server.port}", [engine.model_id]
+        )
+        headers = dict(await gw.inference_headers())
+        # ~130 byte-tokens of shared head, well past the 16-token min prefix
+        system = ("You are the TPU serving assistant. Answer briefly and "
+                  "cite the runbook section when relevant. ") * 2
+        metrics = engine.core.metrics
+
+        ttft_hit: list[float] = []
+        ttft_miss: list[float] = []
+        for i in range(requests):
+            payload = {
+                "model": engine.model_id,
+                "messages": [
+                    {"role": "system", "content": system},
+                    {"role": "user", "content": f"Question {i}: status of "
+                                                f"pool {i % 7}?"},
+                ],
+                "max_tokens": 8, "temperature": 0.0, "stream": True,
+            }
+            hits_before = metrics.prefix_hits_total
+            t0 = time.perf_counter()
+            ttft = None
+            resp = await gw.client.post("/v1/chat/completions", json=payload,
+                                        headers=headers)
+            assert resp.status == 200, await resp.text()
+            async for raw in resp.content:
+                line = raw.decode(errors="replace").strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[len("data: "):])
+                if ttft is None and any(
+                    c.get("delta", {}).get("content")
+                    for c in chunk.get("choices", [])
+                ):
+                    ttft = time.perf_counter() - t0
+            await resp.release()
+            if ttft is None:
+                continue
+            if metrics.prefix_hits_total > hits_before:
+                ttft_hit.append(ttft)
+            else:
+                ttft_miss.append(ttft)
+
+        # cross-check the Prometheus exposition carries the same counters
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{eng_server.port}/metrics"
+            ) as r:
+                exposition = await r.text()
+        assert "llmlb_engine_prefix_cache_hits_total" in exposition
+
+        hits = metrics.prefix_hits_total
+        misses = metrics.prefix_misses_total
+        cached = metrics.prefix_cached_tokens_total
+        # actual shared token head between any two requests of this
+        # workload, aligned down to the engine's prefix quantum — the
+        # denominator for "what fraction of shareable tokens came from cache"
+        ids = [engine.encode_chat([
+            {"role": "system", "content": system},
+            {"role": "user", "content": f"Question {i}: status of "
+                                        f"pool {i % 7}?"},
+        ]) for i in (0, 1)]
+        lcp = 0
+        while (lcp < min(len(ids[0]), len(ids[1]))
+               and ids[0][lcp] == ids[1][lcp]):
+            lcp += 1
+        align = engine.core.prefix_align or 1
+        shared_est = max(1, (requests - 1) * ((lcp // align) * align))
+
+        def mean(xs):
+            return round(sum(xs) / len(xs) * 1000, 2) if xs else None
+
+        return {
+            "metric": "prefix_cache_shared_prefix_workload",
+            "requests": requests,
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 3),
+            "prefill_tokens_saved": cached,
+            "shared_tokens_hit_fraction": round(cached / shared_est, 3),
+            "ttft_hit_mean_ms": mean(ttft_hit),
+            "ttft_miss_mean_ms": mean(ttft_miss),
+            "engine_prefix_cache": engine.core.prefix_cache_info(),
+        }
+    finally:
+        await gw.close()
+        await eng_server.close()
+        engine.shutdown()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--concurrency", type=int, default=50)
+    parser.add_argument("--workload", choices=("proxy", "shared-prefix"),
+                        default="proxy")
+    parser.add_argument("--requests", type=int, default=24,
+                        help="request count for --workload shared-prefix")
     args = parser.parse_args()
-    result = asyncio.run(run_bench(args.seconds, args.concurrency))
+    if args.workload == "shared-prefix":
+        result = asyncio.run(run_prefix_bench(args.requests))
+    else:
+        result = asyncio.run(run_bench(args.seconds, args.concurrency))
     print(json.dumps(result))
 
 

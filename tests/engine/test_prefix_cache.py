@@ -1,0 +1,413 @@
+"""Prefix KV cache: radix-tree bookkeeping, scheduler reuse, perf smoke.
+
+Three layers, mirroring the implementation split:
+- PrefixCache unit tests (pure host-side: insert/match/refcount/evict,
+  bucket alignment, LRU order, edge splitting).
+- EngineCore integration (CPU backend): cache hits serve the shared head
+  from copied KV rows, outputs stay greedy-identical to the cold path,
+  cancellation mid-suffix-prefill releases the donor, disabled flag
+  restores the old behavior.
+- A fast perf smoke asserting a cache-hit insert dispatches NO prefill
+  device step for the cached region — the tier-1 guard against silent
+  re-prefill regressions.
+"""
+
+import queue
+
+import numpy as np
+import pytest
+
+from llmlb_tpu.engine.prefix_cache import PrefixCache
+from llmlb_tpu.engine.presets import get_preset
+from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+
+# ----------------------------------------------------------------- radix tree
+
+
+def make_cache(**kw):
+    kw.setdefault("max_entries", 4)
+    kw.setdefault("min_len", 4)
+    kw.setdefault("align", 4)
+    return PrefixCache(**kw)
+
+
+def test_insert_and_exact_match():
+    c = make_cache()
+    assert c.insert((1, 2, 3, 4, 5, 6, 7, 8), slot=0) is not None
+    got = c.match([1, 2, 3, 4, 5, 6, 7, 8, 9], max_len=8)
+    assert got is not None
+    entry, use_len = got
+    assert entry.slot == 0
+    assert use_len == 8
+    assert c.pinned_slots() == {0}
+    assert c.cached_tokens() == 8
+
+
+def test_match_uses_partial_head_of_longer_entry():
+    """KV rows for [0, m) depend only on tokens [0, m): a stored prefix can
+    donate any of its own prefixes, including partway into a radix edge."""
+    c = make_cache()
+    c.insert(tuple(range(100, 112)), slot=1)  # 12 tokens
+    # query shares only the first 6 tokens, then diverges
+    got = c.match(list(range(100, 106)) + [999, 998], max_len=7)
+    assert got is not None
+    entry, use_len = got
+    assert entry.slot == 1
+    assert use_len == 4  # 6 matched, aligned down to the 4-token quantum
+
+
+def test_match_respects_max_len_and_min_len():
+    c = make_cache()
+    c.insert((1, 2, 3, 4, 5, 6, 7, 8), slot=0)
+    # an identical prompt must leave >= 1 suffix token: max_len = n - 1
+    entry, use_len = c.match([1, 2, 3, 4, 5, 6, 7, 8], max_len=7)
+    assert use_len == 4  # 7 aligned down
+    # matches shorter than min_len are worthless
+    assert c.match([1, 2, 3, 9], max_len=3) is None
+
+
+def test_edge_split_on_divergent_insert():
+    c = make_cache()
+    c.insert((1, 2, 3, 4, 5, 6, 7, 8), slot=0)
+    c.insert((1, 2, 3, 4, 9, 9, 9, 9), slot=1)  # splits the edge at depth 4
+    e0, u0 = c.match([1, 2, 3, 4, 5, 6, 7, 8, 0], max_len=8)
+    e1, u1 = c.match([1, 2, 3, 4, 9, 9, 9, 9, 0], max_len=8)
+    assert (e0.slot, u0) == (0, 8)
+    assert (e1.slot, u1) == (1, 8)
+    assert len(c) == 2
+
+
+def test_covers_blocks_duplicate_coverage_but_allows_extension():
+    c = make_cache()
+    c.insert((1, 2, 3, 4), slot=0)
+    assert c.covers((1, 2, 3, 4))
+    assert c.insert((1, 2, 3, 4), slot=1) is None  # no new coverage
+    # a LONGER prefix is new coverage
+    assert c.insert((1, 2, 3, 4, 5, 6, 7, 8), slot=1) is not None
+    # ...and the short one is now covered by the long one too
+    assert c.covers((1, 2, 3, 4))
+
+
+def test_refcount_blocks_eviction():
+    c = make_cache()
+    e = c.insert((1, 2, 3, 4), slot=0)
+    c.acquire(e)
+    assert c.evict_lru() is None  # in-flight reader pins it
+    c.release(e)
+    assert c.evict_lru() == 0
+    assert len(c) == 0
+    assert c.match([1, 2, 3, 4, 5], max_len=4) is None
+
+
+def test_lru_eviction_order_and_match_refreshes():
+    c = make_cache()
+    c.insert((1,) * 8, slot=0)
+    c.insert((2,) * 8, slot=1)
+    c.insert((3,) * 8, slot=2)
+    c.match([1] * 9, max_len=8)  # a match refreshes slot 0's clock
+    assert c.evict_lru() == 1    # slot 1 is now the oldest untouched
+    assert c.evict_lru() == 2
+    assert c.evict_lru() == 0
+    assert c.evict_lru() is None
+
+
+def test_evict_subsumed_reclaims_ancestor_donors():
+    """A longer prefix covers every match its ancestors could serve; the
+    ancestors' donor slots are reclaimed instead of bleeding the budget one
+    slot per conversation turn."""
+    c = make_cache()
+    e1 = c.insert((1, 2, 3, 4), slot=0)
+    turn2 = (1, 2, 3, 4, 5, 6, 7, 8)
+    assert c.evict_subsumed(turn2) == [0]
+    c.insert(turn2, slot=1)
+    assert c.pinned_slots() == {1}
+    # coverage is preserved: the short head still matches via the long entry
+    entry, use_len = c.match([1, 2, 3, 4, 9], max_len=4)
+    assert entry.slot == 1 and use_len == 4
+    # an acquired ancestor is NOT reclaimed (in-flight reader)
+    e2 = c.insert((9, 9, 9, 9), slot=2)
+    c.acquire(e2)
+    assert c.evict_subsumed((9, 9, 9, 9, 1, 1, 1, 1)) == []
+    c.release(e2)
+    assert e1.node is None  # removed entry is fully detached
+
+
+def test_budget_rejects_insert_when_full():
+    c = make_cache(max_entries=1)
+    assert c.insert((1, 2, 3, 4), slot=0) is not None
+    assert c.insert((5, 6, 7, 8), slot=1) is None  # caller must evict first
+    assert c.evict_lru() == 0
+    assert c.insert((5, 6, 7, 8), slot=1) is not None
+
+
+def test_clear_drops_everything():
+    c = make_cache()
+    c.insert((1, 2, 3, 4), slot=0)
+    c.insert((1, 2, 3, 4, 5, 6, 7, 8), slot=1)
+    c.clear()
+    assert len(c) == 0
+    assert c.match([1, 2, 3, 4, 5], max_len=4) is None
+
+
+# ---------------------------------------------------------------- engine core
+
+
+def _run(core, prompt_ids, *, max_tokens=4, temperature=0.0):
+    r = Request(prompt_ids=list(prompt_ids),
+                sampling=SamplingParams(temperature=temperature,
+                                        max_tokens=max_tokens))
+    core.submit(r)
+    toks = []
+    while True:
+        kind, value = r.events.get(timeout=120)
+        if kind == "token":
+            toks.append(value)
+        elif kind == "error":
+            raise AssertionError(f"engine error: {value}")
+        else:
+            return toks, value
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    rng = np.random.default_rng(7)
+    cfg = get_preset("debug-tiny")
+    return list(rng.integers(1, cfg.vocab_size, size=(48,)))
+
+
+def test_cache_hit_reuses_prefix_and_matches_cold_output(prompt):
+    """Warm identical prompt: hit counters move, cached tokens are the
+    aligned head, and greedy output equals the cold run's (the copied KV
+    rows are the same numbers the cold prefill computed)."""
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    core.start()
+    try:
+        cold_toks, cold_fin = _run(core, prompt)
+        m = core.metrics
+        assert m.prefix_misses_total == 1
+        assert m.prefix_insertions_total == 1
+        info = core.prefix_cache_info()
+        assert info["enabled"] and info["entries"] == 1
+        assert info["cached_tokens"] == 48
+
+        warm_toks, warm_fin = _run(core, prompt)
+        assert m.prefix_hits_total == 1
+        # 48-token prompt: reusable head is min(47, ...) aligned to 16 -> 32
+        assert m.prefix_cached_tokens_total == 32
+        assert (warm_toks, warm_fin) == (cold_toks, cold_fin)
+    finally:
+        core.stop()
+
+
+def test_divergent_tail_still_hits_shared_head(prompt):
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    core.start()
+    try:
+        _run(core, prompt)
+        # tail diverges at position 40 (p-1 stays in vocab: prompt ids >= 1)
+        other = prompt[:40] + [p - 1 for p in prompt[40:]]
+        _run(core, other)
+        assert core.metrics.prefix_hits_total == 1
+        assert core.metrics.prefix_cached_tokens_total == 32  # 40 aligned
+    finally:
+        core.stop()
+
+
+def test_slot_pressure_evicts_donors_for_live_traffic():
+    """With every non-pinned slot busy and requests queued, pinned donors
+    are evicted LRU rather than starving the queue."""
+    cfg = get_preset("debug-tiny")
+    rng = np.random.default_rng(3)
+    core = EngineCore(cfg, num_slots=2, slot_capacity=64,
+                      prefill_buckets=(16,), prefix_cache_slots=1, seed=0)
+    core.start()
+    try:
+        prompts = [list(rng.integers(1, cfg.vocab_size, size=(20,)))
+                   for _ in range(4)]
+        for p in prompts:
+            _run(core, p)  # each completion pins (budget 1 -> evictions)
+        assert core.metrics.prefix_evictions_total >= 1
+        assert core.stats().active_slots == 0
+        assert len(core.prefix_cache) <= 1
+    finally:
+        core.stop()
+
+
+def _drive_to_completion(core, request, limit=500):
+    """Run the step loop inline (core not started) until `request` finishes —
+    the same call sequence _loop makes, but deterministic for tests."""
+    core.pending.put(request)
+    for _ in range(limit):
+        core._try_insert()
+        core._advance_prefill()
+        core._decode_active()
+        try:
+            while True:
+                kind, value = request.events.get_nowait()
+                if kind in ("done", "error"):
+                    return kind, value
+        except queue.Empty:
+            pass
+    raise AssertionError("request did not finish")
+
+
+def test_cancel_mid_suffix_prefill_releases_entry(prompt):
+    """A cache-hit request cancelled during its suffix prefill must release
+    the donor entry (refcount back to 0) so it stays evictable. Driven
+    inline — the loop thread is never started — so the cancellation lands
+    exactly between the KV-row copy and the first suffix chunk."""
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    # warm the cache with one completed request
+    kind, _ = _drive_to_completion(
+        core, Request(prompt_ids=list(prompt),
+                      sampling=SamplingParams(temperature=0.0, max_tokens=2)))
+    assert kind == "done"
+    (entry,) = core.prefix_cache.entries()
+
+    r = Request(prompt_ids=list(prompt),
+                sampling=SamplingParams(temperature=0.0, max_tokens=8))
+    core.pending.put(r)
+    core._try_insert()  # hit: copies rows, acquires the donor, prefilling
+    assert core.metrics.prefix_hits_total == 1
+    assert entry.refcount == 1
+    assert core.prefix_cache.evict_lru() is None  # reader pins the donor
+
+    r.cancel()
+    core._advance_prefill()  # observes the cancellation mid-suffix-prefill
+    assert r.events.get_nowait() == ("done", "cancelled")
+    assert entry.refcount == 0
+    assert core.prefix_cache.evict_lru() is not None  # evictable again
+
+
+def test_multi_turn_conversation_reuses_one_donor_slot(prompt):
+    """Growing-conversation shape: each turn extends the last prompt. The
+    cache must hold ONE entry for the conversation (ancestors reclaimed),
+    not one pinned slot per turn."""
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), prefix_cache_slots=3, seed=0)
+    core.start()
+    try:
+        turn = list(prompt[:16])
+        for growth in (16, 16):  # 16 -> 32 -> 48 tokens
+            _run(core, turn)
+            turn = turn + [p - 1 for p in prompt[:growth]]
+        _run(core, turn)
+        assert len(core.prefix_cache) == 1  # one donor covers all turns
+        (entry,) = core.prefix_cache.entries()
+        assert entry.length == 48
+    finally:
+        core.stop()
+
+
+def test_env_var_disables_prefix_cache(monkeypatch):
+    """LLMLB_PREFIX_CACHE accepts the same off vocabulary as the CLI flag —
+    an operator's emergency disable must not silently no-op."""
+    for value in ("0", "false", "off", "no"):
+        monkeypatch.setenv("LLMLB_PREFIX_CACHE", value)
+        core = EngineCore(get_preset("debug-tiny"), num_slots=2,
+                          slot_capacity=64, prefill_buckets=(16,), seed=0)
+        assert core.prefix_cache is None, value
+    monkeypatch.setenv("LLMLB_PREFIX_CACHE", "1")
+    core = EngineCore(get_preset("debug-tiny"), num_slots=2,
+                      slot_capacity=64, prefill_buckets=(16,), seed=0)
+    assert core.prefix_cache is not None
+
+
+def test_disabled_flag_restores_plain_scheduler(prompt):
+    core = EngineCore(get_preset("debug-tiny"), num_slots=2, slot_capacity=64,
+                      prefill_buckets=(16,), prefix_cache=False, seed=0)
+    core.start()
+    try:
+        assert core.prefix_cache is None
+        assert core.prefix_cache_info() == {"enabled": False}
+        _run(core, prompt)
+        _run(core, prompt)
+        m = core.metrics
+        assert (m.prefix_hits_total, m.prefix_misses_total,
+                m.prefix_insertions_total) == (0, 0, 0)
+    finally:
+        core.stop()
+
+
+def test_prefix_metrics_in_prometheus_and_summary(prompt):
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    core.start()
+    try:
+        _run(core, prompt)
+        _run(core, prompt)
+        stats = core.stats()
+        text = core.metrics.render(
+            queue_depth=stats.queued, active_slots=stats.active_slots,
+            num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
+        )
+        assert "llmlb_engine_prefix_cache_hits_total 1" in text
+        assert "llmlb_engine_prefix_cache_misses_total 1" in text
+        assert "llmlb_engine_prefix_cache_cached_tokens_total 32" in text
+        assert "llmlb_engine_prefix_cache_evictions_total 0" in text
+        assert "llmlb_engine_prefix_cache_pinned_slots 1" in text
+        assert "llmlb_engine_prefix_cache_pinned_hbm_bytes" in text
+        summary = core.metrics.summary()
+        assert summary["prefix_hits_total"] == 1
+        assert summary["prefix_cached_tokens_total"] == 32
+    finally:
+        core.stop()
+
+
+# ----------------------------------------------------------------- perf smoke
+
+
+def test_cache_hit_skips_prefill_for_cached_region(prompt):
+    """Tier-1 regression guard: a hit must dispatch prefill steps ONLY for
+    the uncached suffix. 48-token prompt over 16-token chunks: 3 dispatches
+    cold, exactly 1 warm (32 tokens ride the device-side row copy)."""
+    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
+                      prefill_buckets=(16,), seed=0)
+    core.start()
+    try:
+        m = core.metrics
+        _run(core, prompt)
+        cold_steps = m.prefill_step.n
+        assert cold_steps == 3
+        _run(core, prompt)
+        warm_steps = m.prefill_step.n - cold_steps
+        assert m.prefix_hits_total == 1
+        assert warm_steps == 1, (
+            f"cache hit re-prefilled the cached region: {warm_steps} "
+            f"dispatches for a 16-token suffix"
+        )
+    finally:
+        core.stop()
+
+
+def test_engine_health_and_system_carry_prefix_block():
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+
+    async def run():
+        engine = Engine.from_preset(
+            "debug-tiny", num_slots=2, slot_capacity=64, prefill_buckets=(16,)
+        )
+        client = TestClient(TestServer(create_engine_app(engine)))
+        await client.start_server()
+        try:
+            health = await (await client.get("/api/health")).json()
+            assert health["prefix_cache"]["enabled"] is True
+            assert health["prefix_cache"]["budget_slots"] == 1
+            assert "prefix_hits_total" in health["metrics"]
+            system = await (await client.get("/api/system")).json()
+            assert system["prefix_cache"]["enabled"] is True
+            metrics_text = await (await client.get("/metrics")).text()
+            assert "llmlb_engine_prefix_cache_hits_total" in metrics_text
+        finally:
+            await client.close()
+            engine.core.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,229 @@
+"""Prefix-affinity routing: same prompt head -> same endpoint while healthy;
+fallback to normal scoring on unhealthy/absent/at-cap/evicted endpoints.
+
+Parametrized over both LoadManager cores (pure Python and the native C++
+router when built) — affinity lives on the Python side and must behave
+identically in front of either scorer.
+"""
+
+import asyncio
+
+import pytest
+
+from llmlb_tpu.gateway.balancer import (
+    PREFIX_AFFINITY_TTL_S,
+    LoadManager,
+    prefix_affinity_hash,
+)
+from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
+
+
+def ep(name: str) -> Endpoint:
+    return Endpoint(name=name, base_url=f"http://{name}:1234")
+
+
+def native_available() -> bool:
+    try:
+        from llmlb_tpu.native import NativeRouterCore
+
+        NativeRouterCore(0.2)
+        return True
+    except Exception:
+        return False
+
+
+CORES = [False] + ([True] if native_available() else [])
+
+
+@pytest.fixture(params=CORES, ids=lambda n: "native" if n else "python")
+def lm(request):
+    return LoadManager(use_native=request.param)
+
+
+def test_hash_is_stable_and_model_scoped():
+    h1 = prefix_affinity_hash("m", "You are a helpful assistant. " * 20)
+    h2 = prefix_affinity_hash("m", "You are a helpful assistant. " * 20)
+    assert h1 == h2
+    assert prefix_affinity_hash("other-model", "You are a helpful "
+                                "assistant. " * 20) != h1
+    # only the head participates: text diverging past the cap still matches
+    base = "s" * 600
+    assert prefix_affinity_hash("m", base + "A") == prefix_affinity_hash(
+        "m", base + "B"
+    )
+    assert prefix_affinity_hash("m", "") is None
+    # tiny prompts can never hit the engine's min cacheable prefix: no pin,
+    # so TPS/telemetry placement keeps full control of them
+    assert prefix_affinity_hash("m", "user:x") is None
+
+
+def test_same_hash_sticks_to_one_endpoint(lm):
+    endpoints = [ep("a"), ep("b"), ep("c")]  # all unmeasured: RR would rotate
+    h = prefix_affinity_hash("m", "shared system prompt " * 10)
+    first = lm.select_endpoint(endpoints, "m", prefix_hash=h)
+    picks = [lm.select_endpoint(endpoints, "m", prefix_hash=h)
+             for _ in range(5)]
+    assert all(p is first for p in picks)
+    stats = lm.affinity_stats()
+    assert stats["hits_total"] == 5
+    assert stats["misses_total"] == 1
+    assert stats["entries"] == 1
+
+
+def test_no_hash_keeps_round_robin(lm):
+    endpoints = [ep("a"), ep("b"), ep("c")]
+    picks = {lm.select_endpoint(endpoints, "m").name for _ in range(3)}
+    assert picks == {"a", "b", "c"}
+
+
+def test_distinct_hashes_spread_while_sticking(lm):
+    """Different prefixes may land on different endpoints (RR underneath),
+    but each prefix individually stays put."""
+    endpoints = [ep("a"), ep("b")]
+    h1 = prefix_affinity_hash("m", "prefix one " * 12)
+    h2 = prefix_affinity_hash("m", "prefix two " * 12)
+    e1 = lm.select_endpoint(endpoints, "m", prefix_hash=h1)
+    e2 = lm.select_endpoint(endpoints, "m", prefix_hash=h2)
+    assert e1 is not e2  # RR assigned the second prefix to the other engine
+    assert lm.select_endpoint(endpoints, "m", prefix_hash=h1) is e1
+    assert lm.select_endpoint(endpoints, "m", prefix_hash=h2) is e2
+
+
+def test_fallback_when_sticky_endpoint_disappears(lm):
+    """Unhealthy/removed endpoints are not in the candidate list; the hash
+    re-pins to whatever healthy endpoint wins."""
+    a, b = ep("a"), ep("b")
+    h = prefix_affinity_hash("m", "pinned prompt " * 10)
+    sticky = lm.select_endpoint([a, b], "m", prefix_hash=h)
+    survivor = b if sticky is a else a
+    got = lm.select_endpoint([survivor], "m", prefix_hash=h)
+    assert got is survivor
+    # re-pinned: the survivor now holds the affinity even among both
+    assert lm.select_endpoint([a, b], "m", prefix_hash=h) is survivor
+
+
+@pytest.mark.parametrize("use_native", CORES,
+                         ids=lambda n: "native" if n else "python")
+def test_fallback_when_sticky_endpoint_at_cap(use_native):
+    lm = LoadManager(QueueConfig(max_active_per_endpoint=1),
+                     use_native=use_native)
+    a, b = ep("a"), ep("b")
+    h = prefix_affinity_hash("m", "hot prompt " * 12)
+    got = lm.try_admit([a, b], "m", TpsApiKind.CHAT, prefix_hash=h)
+    assert got is not None
+    sticky, lease = got
+    other = b if sticky is a else a
+    # sticky endpoint holds its only slot; the same hash must overflow
+    got2 = lm.try_admit([a, b], "m", TpsApiKind.CHAT, prefix_hash=h)
+    assert got2 is not None
+    assert got2[0] is other
+    lease.fail()
+    got2[1].fail()
+
+
+def test_affinity_cleared_on_endpoint_failure(lm):
+    a, b = ep("a"), ep("b")
+    h = prefix_affinity_hash("m", "flapping prompt " * 10)
+    sticky = lm.select_endpoint([a, b], "m", prefix_hash=h)
+    lm.clear_tps_for_endpoint(sticky.id)
+    assert lm.affinity_stats()["entries"] == 0
+    other = b if sticky is a else a
+    # next selection re-learns; with the old pin gone RR moves on
+    assert lm.select_endpoint([other], "m", prefix_hash=h) is other
+
+
+def test_affinity_entry_expires(lm):
+    a, b = ep("a"), ep("b")
+    h = prefix_affinity_hash("m", "stale prompt " * 12)
+    sticky = lm.select_endpoint([a, b], "m", prefix_hash=h)
+    assert lm.select_endpoint([a, b], "m", prefix_hash=h) is sticky
+    # age the pin past the TTL: the next lookup must treat it as a miss
+    # (re-scored, re-pinned) instead of steering to a long-dead prefix
+    key = ("m", h)
+    eid, ts = lm._affinity[key]
+    lm._affinity[key] = (eid, ts - PREFIX_AFFINITY_TTL_S - 1)
+    misses_before = lm.affinity_stats()["misses_total"]
+    got = lm.select_endpoint([a, b], "m", prefix_hash=h)
+    assert got is not None
+    assert lm.affinity_stats()["misses_total"] == misses_before + 1
+
+
+def test_affinity_map_is_bounded(lm, monkeypatch):
+    import llmlb_tpu.gateway.balancer as balancer_mod
+
+    monkeypatch.setattr(balancer_mod, "PREFIX_AFFINITY_CAPACITY", 8)
+    endpoints = [ep("a"), ep("b")]
+    for i in range(50):
+        h = prefix_affinity_hash("m", f"unique prefix {i} " * 10)
+        lm.select_endpoint(endpoints, "m", prefix_hash=h)
+    assert lm.affinity_stats()["entries"] <= 8
+
+
+async def _admit_with_hash(lm, endpoints, h):
+    result = await _make_admission(lm).admit(
+        lambda: endpoints, "m", TpsApiKind.CHAT, timeout_s=0.2, prefix_hash=h
+    )
+    return result
+
+
+def _make_admission(lm):
+    from llmlb_tpu.gateway.balancer import AdmissionQueue
+
+    return AdmissionQueue(lm)
+
+
+def test_admission_queue_passes_prefix_hash(lm):
+    async def run():
+        endpoints = [ep("a"), ep("b"), ep("c")]
+        h = prefix_affinity_hash("m", "queued prompt " * 10)
+        r1 = await _admit_with_hash(lm, endpoints, h)
+        assert r1.admitted
+        r2 = await _admit_with_hash(lm, endpoints, h)
+        assert r2.admitted
+        assert r2.endpoint is r1.endpoint
+        r1.lease.complete()
+        r2.lease.complete()
+
+    asyncio.run(run())
+
+
+def test_gateway_routes_shared_prefix_to_one_upstream():
+    """Full proxy path: two mock engines, repeated chat bodies sharing a
+    system prompt — every request must reach the SAME upstream, and the
+    affinity counters must appear in the gateway /metrics exposition."""
+    from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+    async def run():
+        gw = await GatewayHarness.create()
+        up1 = await MockOpenAIEndpoint(model="m").start()
+        up2 = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(up1.url, ["m"], name="up1")
+            gw.register_mock(up2.url, ["m"], name="up2")
+            headers = dict(await gw.inference_headers())
+            system = "You are a careful reviewer. " * 8
+            for i in range(6):
+                resp = await gw.client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [
+                        {"role": "system", "content": system},
+                        {"role": "user", "content": f"question {i}"},
+                    ],
+                }, headers=headers)
+                assert resp.status == 200, await resp.text()
+                await resp.read()
+            counts = (len(up1.requests_seen), len(up2.requests_seen))
+            assert sorted(counts) == [0, 6], counts  # all stuck to one engine
+
+            text = await (await gw.client.get("/metrics")).text()
+            assert "llmlb_gateway_prefix_affinity_hits_total 5" in text
+            assert "llmlb_gateway_prefix_affinity_misses_total 1" in text
+            assert "llmlb_gateway_prefix_affinity_evictions_total 0" in text
+            assert "llmlb_gateway_prefix_affinity_entries 1" in text
+        finally:
+            await up1.stop()
+            await up2.stop()
+            await gw.close()
+
+    asyncio.run(run())
