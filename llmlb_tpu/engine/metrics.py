@@ -178,10 +178,13 @@ class EngineMetrics:
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
-               num_slots: int, prefix_cache: dict | None = None) -> str:
+               num_slots: int, prefix_cache: dict | None = None,
+               kv_cache: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
-        there; the event counters live here)."""
+        there; the event counters live here); `kv_cache` is its
+        kv_cache_info() block — page-pool gauges render when the paged
+        layout is active."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -230,6 +233,34 @@ class EngineMetrics:
                     "# TYPE llmlb_engine_prefix_cache_pinned_hbm_bytes gauge",
                     "llmlb_engine_prefix_cache_pinned_hbm_bytes "
                     f"{prefix_cache['pinned_hbm_bytes']}",
+                ]
+                if "pinned_pages" in prefix_cache:
+                    lines += [
+                        "# TYPE llmlb_engine_prefix_cache_pinned_pages gauge",
+                        "llmlb_engine_prefix_cache_pinned_pages "
+                        f"{prefix_cache['pinned_pages']}",
+                    ]
+            if kv_cache is not None and kv_cache.get("layout") == "paged":
+                lines += [
+                    "# TYPE llmlb_engine_kv_pages_total gauge",
+                    f"llmlb_engine_kv_pages_total {kv_cache['pages_total']}",
+                    "# TYPE llmlb_engine_kv_pages_free gauge",
+                    f"llmlb_engine_kv_pages_free {kv_cache['pages_free']}",
+                    "# TYPE llmlb_engine_kv_pages_active gauge",
+                    f"llmlb_engine_kv_pages_active {kv_cache['pages_active']}",
+                    "# TYPE llmlb_engine_kv_pages_pinned gauge",
+                    f"llmlb_engine_kv_pages_pinned {kv_cache['pages_pinned']}",
+                    "# TYPE llmlb_engine_kv_page_size_tokens gauge",
+                    f"llmlb_engine_kv_page_size_tokens {kv_cache['page_size']}",
+                    "# TYPE llmlb_engine_kv_pool_utilization_ratio gauge",
+                    "llmlb_engine_kv_pool_utilization_ratio "
+                    f"{kv_cache['utilization']}",
+                    "# TYPE llmlb_engine_kv_page_fragmentation_ratio gauge",
+                    "llmlb_engine_kv_page_fragmentation_ratio "
+                    f"{kv_cache['fragmentation']}",
+                    "# TYPE llmlb_engine_kv_page_waste_tokens_mean gauge",
+                    "llmlb_engine_kv_page_waste_tokens_mean "
+                    f"{kv_cache['waste_tokens_mean']}",
                 ]
             for name, hist in (
                 ("llmlb_engine_ttft_seconds", self.ttft),

@@ -37,14 +37,22 @@ import dataclasses
 
 @dataclasses.dataclass
 class PrefixEntry:
-    """One cached prefix: `tokens` are resident as KV rows [0, len(tokens))
-    of pinned slot `slot` in the engine's slot cache."""
+    """One cached prefix. Dense layout: `tokens` are resident as KV rows
+    [0, len(tokens)) of pinned slot `slot` in the engine's slot cache.
+    Paged layout: `pages` names the pool pages holding those rows in order
+    (`slot` is -1; the donor slot itself was freed at donation time — a hit
+    copies the page ids into the reader's block table with a refcount bump,
+    never the KV bytes)."""
 
     tokens: tuple[int, ...]
     slot: int
     refcount: int = 0
     last_used: int = 0
+    pages: tuple[int, ...] | None = None
     node: "_Node | None" = dataclasses.field(default=None, repr=False)
+    # key in the cache's entry dict (the slot for dense entries, a unique
+    # negative id for paged ones — freed slots recycle their ids, pages don't)
+    key: int = dataclasses.field(default=0, repr=False)
 
     @property
     def length(self) -> int:
@@ -84,9 +92,12 @@ class PrefixCache:
         self.min_len = min_len
         self.align = align
         self._root = _Node(())
+        # keyed by entry.key: the donor slot id for dense entries, a unique
+        # negative id for paged (page-backed) entries
         self._by_slot: dict[int, PrefixEntry] = {}
         self._cached_tokens = 0
         self._clock = 0
+        self._next_paged_key = -2  # -1 is the scheduler's "no slot" marker
 
     # ------------------------------------------------------------- inspection
     #
@@ -99,7 +110,11 @@ class PrefixCache:
         return len(self._by_slot)
 
     def pinned_slots(self) -> frozenset[int]:
-        return frozenset(self._by_slot)
+        """Donor SLOTS held out of the serving pool — dense entries only
+        (page-backed donors pin pages, their slots were freed at donation)."""
+        return frozenset(
+            e.slot for e in self._by_slot.values() if e.pages is None
+        )
 
     def cached_tokens(self) -> int:
         return self._cached_tokens
@@ -195,13 +210,16 @@ class PrefixCache:
 
     # ----------------------------------------------------------------- insert
 
-    def insert(self, tokens, slot: int) -> PrefixEntry | None:
-        """Pin `slot` as the donor for prefix `tokens`. Returns the new entry,
-        or None when rejected (budget full, duplicate coverage, or a slot
-        already pinned). The caller aligns/filters lengths and evicts to make
-        room first."""
+    def insert(self, tokens, slot: int,
+               pages: tuple[int, ...] | None = None) -> PrefixEntry | None:
+        """Pin a donor for prefix `tokens`: slot `slot` (dense) or the pool
+        pages `pages` (paged; pass slot=-1). Returns the new entry, or None
+        when rejected (budget full, duplicate coverage, or a slot already
+        pinned). The caller aligns/filters lengths, evicts to make room
+        first, and owns the page refcounts."""
         tokens = tuple(tokens)
-        if (not tokens or slot in self._by_slot
+        if (not tokens
+                or (pages is None and slot in self._by_slot)
                 or len(self._by_slot) >= self.max_entries
                 or self.covers(tokens)):
             return None
@@ -227,22 +245,33 @@ class PrefixCache:
             else:
                 node = child
             pos += lcp
-        entry = PrefixEntry(tokens=tokens, slot=slot,
-                            last_used=self._tick(), node=node)
+        if pages is None:
+            key = slot
+        else:
+            key = self._next_paged_key
+            self._next_paged_key -= 1
+        entry = PrefixEntry(tokens=tokens, slot=slot, pages=pages,
+                            last_used=self._tick(), node=node, key=key)
         node.entry = entry
-        self._by_slot[slot] = entry
+        self._by_slot[key] = entry
         self._cached_tokens += entry.length
         return entry
 
     # ------------------------------------------------------------------ evict
 
     def evict_subsumed(self, tokens) -> list[int]:
+        """Remove entries whose tokens are a STRICT prefix of `tokens`,
+        returning their freed slots (see evict_subsumed_entries)."""
+        return [e.slot for e in self.evict_subsumed_entries(tokens)]
+
+    def evict_subsumed_entries(self, tokens) -> list["PrefixEntry"]:
         """Remove entries whose tokens are a STRICT prefix of `tokens` (and
-        have no in-flight readers), returning their freed slots. Called
-        before inserting `tokens`: any query matching a shorter ancestor
-        also matches through the longer entry's subtree, so the ancestor is
-        dead weight — without this, each turn of a growing conversation
-        would pin a fresh donor slot until the budget was exhausted."""
+        have no in-flight readers), returning them so the caller can release
+        their donor slots / page references. Called before inserting
+        `tokens`: any query matching a shorter ancestor also matches through
+        the longer entry's subtree, so the ancestor is dead weight — without
+        this, each turn of a growing conversation would pin a fresh donor
+        until the budget was exhausted."""
         tokens = tuple(tokens)
         victims: list[PrefixEntry] = []
         node = self._root
@@ -261,12 +290,18 @@ class PrefixCache:
                 victims.append(node.entry)
         for entry in victims:
             self._remove(entry)
-        return [entry.slot for entry in victims]
+        return victims
 
     def evict_lru(self) -> int | None:
         """Remove the least-recently-used entry with no in-flight readers.
         Returns the freed slot id (the scheduler returns it to the free
         pool), or None when every entry is acquired."""
+        entry = self.evict_lru_entry()
+        return None if entry is None else entry.slot
+
+    def evict_lru_entry(self) -> PrefixEntry | None:
+        """evict_lru returning the whole entry — the paged scheduler needs
+        the page list to release its references."""
         victim: PrefixEntry | None = None
         for entry in self._by_slot.values():
             if entry.refcount:
@@ -276,10 +311,10 @@ class PrefixCache:
         if victim is None:
             return None
         self._remove(victim)
-        return victim.slot
+        return victim
 
     def _remove(self, entry: PrefixEntry) -> None:
-        del self._by_slot[entry.slot]
+        del self._by_slot[entry.key]
         self._cached_tokens -= entry.length
         node = entry.node
         entry.node = None

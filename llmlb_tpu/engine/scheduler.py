@@ -1,9 +1,14 @@
 """Continuous-batching scheduler: prefill/decode split over a slot cache.
 
 JetStream-style serving loop, TPU-first:
-- A fixed pool of NUM_SLOTS decode slots backed by one static-shape KV cache
-  [L, NUM_SLOTS, CAP, K, D] living in HBM. One compiled `decode_step` serves
-  every mix of requests — raggedness is masks, never shapes.
+- A fixed pool of NUM_SLOTS decode slots. KV lives in one of two layouts:
+  paged (default) — a global page pool [L, PAGES, PAGE, K, D] plus a
+  per-slot block table (engine/paging.py owns the refcounted allocator), so
+  HBM is held per page of tokens actually cached and short requests no
+  longer strand slot_capacity rows each; or dense — one static-shape cache
+  [L, NUM_SLOTS, CAP, K, D], the original layout, preserved bit for bit
+  behind --kv-layout dense. Either way one compiled `decode_step` serves
+  every mix of requests — raggedness is masks and tables, never shapes.
 - New requests prefill one at a time at bucketed prompt lengths (pow2 buckets ⇒
   a handful of compiles) and scatter straight into a free slot row
   (`prefill_into_slots`), while other slots keep decoding between prefills.
@@ -37,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from llmlb_tpu.engine.metrics import EngineMetrics
+from llmlb_tpu.engine.paging import PagePool
 from llmlb_tpu.engine.prefix_cache import PrefixCache, PrefixEntry
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
@@ -47,16 +53,29 @@ log = logging.getLogger("llmlb_tpu.engine")
 
 
 def kv_cache_bytes(cfg, num_slots: int, slot_capacity: int) -> int:
-    """HBM footprint of the contiguous slot cache [L, slots, cap, K, D] ×2
-    (K and V). The serving memory budget is
+    """HBM footprint of the DENSE contiguous slot cache [L, slots, cap, K, D]
+    ×2 (K and V). The serving memory budget is
         weights ≈ 2·n_params bytes (bf16)
         kv      = L · slots · cap · K · D · 2(kv) · itemsize
     e.g. llama-3-8b (L=32, K=8, D=128) at 8×4096: 4.3 GiB — fits v5e-4 tp
     alongside the 16 GiB of weights; tinyllama-1.1b (L=22, K=4, D=64) at
     16×8192: 2.95 GiB on a single chip. The default capacity is sized so a
-    4k-token prompt serves out of the box (VERDICT r2 item 5)."""
+    4k-token prompt serves out of the box (VERDICT r2 item 5). In paged mode
+    (the default) the footprint is kv_pool_bytes instead — every slot shares
+    one page pool, so short requests no longer strand `cap` rows each."""
     itemsize = jnp.dtype(cfg.dtype).itemsize
     return (cfg.num_layers * num_slots * slot_capacity
+            * cfg.num_kv_heads * cfg.head_dim_ * 2 * itemsize)
+
+
+def kv_pool_bytes(cfg, num_pages: int, page_size: int) -> int:
+    """HBM footprint of the PAGED KV pool [L, pages, page_size, K, D] ×2
+    (K and V). At the default sizing (num_pages = slots · cap/page_size + 1)
+    this matches the dense footprint within one trash page — the occupancy
+    win comes from admitting MORE slots against the same pool, not from a
+    smaller pool."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return (cfg.num_layers * num_pages * page_size
             * cfg.num_kv_heads * cfg.head_dim_ * 2 * itemsize)
 
 
@@ -70,6 +89,24 @@ def _scatter_kv_row(cache_k, cache_v, k_all, v_all, slot_id):
     return (
         jax.lax.dynamic_update_slice(cache_k, k_all.astype(cache_k.dtype), start),
         jax.lax.dynamic_update_slice(cache_v, v_all.astype(cache_v.dtype), start),
+    )
+
+
+@partial(jax.jit, donate_argnames=("cache_k", "cache_v"))
+def _scatter_kv_row_paged(cache_k, cache_v, k_all, v_all, table_row):
+    """Paged counterpart of _scatter_kv_row: land a context-parallel
+    prefill's KV [L, 1, T, K, D] in the pool pages named by `table_row`
+    [PPN] (positions past the allocated pages hit the trash page — padding
+    garbage, same contract as the dense scatter's cells past the valid
+    length)."""
+    t = k_all.shape[2]
+    ps = cache_k.shape[2]
+    pos = jnp.arange(t, dtype=jnp.int32)
+    page = table_row[jnp.minimum(pos // ps, table_row.shape[0] - 1)]
+    off = pos % ps
+    return (
+        cache_k.at[:, page, off].set(k_all[:, 0].astype(cache_k.dtype)),
+        cache_v.at[:, page, off].set(v_all[:, 0].astype(cache_v.dtype)),
     )
 
 
@@ -174,6 +211,9 @@ class EngineCore:
         prefix_cache: bool | None = None,
         prefix_cache_slots: int | None = None,
         min_prefix_len: int | None = None,
+        kv_layout: str | None = None,
+        kv_page_size: int | None = None,
+        kv_pages: int | None = None,
     ):
         self.cfg = cfg
         # Family module (llama / mixtral) supplying the serving fns — one
@@ -186,6 +226,40 @@ class EngineCore:
         )
         self.eos_id = eos_id
 
+        # KV layout: "paged" (default) backs every slot with a shared page
+        # pool + per-slot block table, so HBM is held per token actually
+        # cached instead of slot_capacity rows per request; "dense" keeps the
+        # contiguous [L, slots, cap, K, D] block and the original code paths
+        # bit for bit (every paged branch below gates on `self.page_pool`).
+        if kv_layout is None:
+            kv_layout = os.environ.get("LLMLB_KV_LAYOUT", "paged")
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'dense', got {kv_layout!r}"
+            )
+        if kv_layout == "paged" and not hasattr(self.family,
+                                                "prefill_into_pages"):
+            log.warning(
+                "model family %s has no paged serving path; falling back to "
+                "the dense slot cache", self.family.__name__,
+            )
+            kv_layout = "dense"
+        self.kv_layout = kv_layout
+        # Page size: TPU-friendly default of 128 tokens (one flash block),
+        # clamped into the slot capacity. docs/kv-cache.md discusses the
+        # waste-vs-overhead tradeoff of other sizes.
+        self.kv_page_size = max(1, min(kv_page_size or 128,
+                                       self.slot_capacity))
+        self.pages_per_slot = -(-self.slot_capacity // self.kv_page_size)
+        # Pool size resolves after the mesh exists (the per-device default
+        # depends on the dp degree); 0 until the paged cache-init block runs.
+        self._kv_pages_arg = kv_pages
+        self.kv_num_pages = 0
+        # Dense-mode prefix hits dispatch a device-side row copy; paged hits
+        # must never (zero-copy page sharing). Exposed so tests/benches can
+        # assert the paged hit path stays copy-free.
+        self.kv_copy_dispatches = 0
+
         # Prefix KV cache: completed requests may donate their slot to a
         # radix tree keyed on prompt token ids; later requests sharing a
         # prefix copy the cached rows device-side and prefill only the
@@ -197,8 +271,13 @@ class EngineCore:
             ).lower() not in ("0", "false", "off", "no")
         # Matched lengths are aligned DOWN to the smallest prefill bucket so
         # the uncached suffix always starts on a bucket boundary (chunked
-        # prefill then runs at its existing compiled sizes).
+        # prefill then runs at its existing compiled sizes). Paged mode
+        # additionally aligns to whole pages: only FULL pages can be shared
+        # zero-copy (a partially-shared page would mix two requests' rows),
+        # so the quantum is lcm(bucket, page_size).
         self.prefix_align = self.prefill_buckets[0] if self.prefill_buckets else 0
+        if self.kv_layout == "paged" and self.prefix_align:
+            self.prefix_align = math.lcm(self.prefix_align, self.kv_page_size)
         self.min_prefix_len = (
             max(1, int(min_prefix_len)) if min_prefix_len is not None
             else self.prefix_align
@@ -247,15 +326,69 @@ class EngineCore:
             k: jax.device_put(v, shardings[k]) for k, v in params.items()
         }
 
-        ck, cv = self.family.init_kv_cache(cfg, num_slots, self.slot_capacity)
-        ck_sh, cv_sh = self.family.kv_cache_shardings(cfg, self.mesh)
-        self.cache_k = jax.device_put(ck, ck_sh)
-        self.cache_v = jax.device_put(cv, cv_sh)
-        log.info(
-            "KV cache: %d slots x %d capacity = %.2f GiB in HBM",
-            num_slots, self.slot_capacity,
-            kv_cache_bytes(cfg, num_slots, self.slot_capacity) / 2**30,
-        )
+        # Paged-mode host state: the page allocator, per-slot page lists, and
+        # the block tables (host numpy mirror + device array refreshed before
+        # the next dispatch whenever a table row changes).
+        self.page_pool: PagePool | None = None
+        self._slot_pages: list[list[int]] = [[] for _ in range(num_slots)]
+        self._block_tables = np.zeros((num_slots, self.pages_per_slot),
+                                      np.int32)
+        self._d_block_tables = jnp.asarray(self._block_tables)
+        self._tables_dirty = False
+        # A request popped from pending that the pool cannot yet cover waits
+        # here (retried first), preserving arrival order without re-queueing.
+        self._held_request: Request | None = None
+        # Page REFERENCES held by prefix-cache entries (int: GIL-atomic so
+        # scrape threads can read it while the step loop mutates the cache).
+        # Entries sharing head pages each count their reference; the pool's
+        # used() figure is the distinct-page truth.
+        self._prefix_pinned_pages = 0
+
+        if self.kv_layout == "paged":
+            # Default pool: the dense PER-DEVICE footprint plus the reserved
+            # trash page. The dense cache shards its slot axis over dp while
+            # the page pool replicates (pages are shared by every slot, so
+            # they must be co-resident) — sizing from the full slot count on
+            # a dp>1 mesh would multiply per-device KV HBM by dp and OOM a
+            # deployment that fit the dense layout.
+            dp = self.mesh.shape.get("dp", 1)
+            default_pages = (
+                -(-num_slots // dp) * self.pages_per_slot + 1
+            )
+            self.kv_num_pages = max(int(self._kv_pages_arg or default_pages),
+                                    self.pages_per_slot + 1)
+            if dp > 1:
+                log.info(
+                    "paged KV pool replicates over dp=%d; defaulting to the "
+                    "per-device dense budget (%d pages) — raise --kv-pages "
+                    "to trade HBM for aggregate capacity", dp,
+                    self.kv_num_pages,
+                )
+            self.page_pool = PagePool(self.kv_num_pages)
+            ck, cv = self.family.init_kv_pages(cfg, self.kv_num_pages,
+                                               self.kv_page_size)
+            ck_sh, cv_sh = self.family.kv_pages_shardings(cfg, self.mesh)
+            self.cache_k = jax.device_put(ck, ck_sh)
+            self.cache_v = jax.device_put(cv, cv_sh)
+            log.info(
+                "KV cache: paged, %d pages x %d tokens (%d slots, %d "
+                "pages/slot) = %.2f GiB in HBM",
+                self.kv_num_pages, self.kv_page_size, num_slots,
+                self.pages_per_slot,
+                kv_pool_bytes(cfg, self.kv_num_pages,
+                              self.kv_page_size) / 2**30,
+            )
+        else:
+            ck, cv = self.family.init_kv_cache(cfg, num_slots,
+                                               self.slot_capacity)
+            ck_sh, cv_sh = self.family.kv_cache_shardings(cfg, self.mesh)
+            self.cache_k = jax.device_put(ck, ck_sh)
+            self.cache_v = jax.device_put(cv, cv_sh)
+            log.info(
+                "KV cache: dense, %d slots x %d capacity = %.2f GiB in HBM",
+                num_slots, self.slot_capacity,
+                kv_cache_bytes(cfg, num_slots, self.slot_capacity) / 2**30,
+            )
 
         # Context-parallel prefill (ring attention over the mesh sp axis):
         # built lazily per padded length; fills a long prompt's KV in ONE
@@ -394,21 +527,33 @@ class EngineCore:
             return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
         param_shapes = {k: sharded(v) for k, v in self.params.items()}
-        args = (
+        paged = self.page_pool is not None
+        args = [
             param_shapes,
             plain(self._d_last_tokens),
             plain(self._d_seq_lens),
             sharded(self.cache_k), sharded(self.cache_v),
+        ]
+        if paged:
+            args.append(plain(self._d_block_tables))
+        args += [
             plain(self._d_temps), plain(self._d_top_ps),
             plain(self._d_top_ks),
             plain(self._key),  # split keys keep this shape/dtype
-        )
+        ]
         for w in self._window_buckets:
             if not self._running:
                 return
             try:
                 if self.decode_burst > 1:
                     self._decode_many_for(w).lower(*args).compile()
+                elif paged:
+                    self.family.decode_step_paged.lower(
+                        param_shapes, self.cfg, plain(self._d_last_tokens),
+                        plain(self._d_seq_lens), sharded(self.cache_k),
+                        sharded(self.cache_v), plain(self._d_block_tables),
+                        self.mesh, window=w,
+                    ).compile()
                 else:
                     # single-step mode compiles decode_step per window too
                     self.family.decode_step.lower(
@@ -463,6 +608,8 @@ class EngineCore:
     def stats(self) -> EngineStats:
         active = sum(1 for s in self.slots if s.request is not None)
         queued = self.pending.qsize()
+        if self._held_request is not None:
+            queued += 1  # parked on page-pool pressure, still queued work
         if self.coordinator is not None:
             # Multihost: requests sitting in the leader's intake queue or
             # spilled to the next tick's plan backlog are queued work the
@@ -535,6 +682,8 @@ class EngineCore:
             new.append(req)
         cancelled = []
         in_flight = [s.request for s in self.slots if s.request is not None]
+        if self._held_request is not None:
+            in_flight.append(self._held_request)  # parked on the page pool
         # snapshot under the queue's own mutex — iterating .queue while a
         # concurrent put() mutates the deque is undefined; the lock makes the
         # snapshot atomic regardless of which thread produces into pending
@@ -622,8 +771,20 @@ class EngineCore:
                 time.sleep(0.001)
 
     def _reset_caches(self) -> None:
-        ck, cv = self.family.init_kv_cache(self.cfg, self.num_slots, self.slot_capacity)
-        ck_sh, cv_sh = self.family.kv_cache_shardings(self.cfg, self.mesh)
+        if self.page_pool is not None:
+            ck, cv = self.family.init_kv_pages(self.cfg, self.kv_num_pages,
+                                               self.kv_page_size)
+            ck_sh, cv_sh = self.family.kv_pages_shardings(self.cfg, self.mesh)
+            # every page mapping is void with the rebuilt pool
+            self.page_pool.reset()
+            self._slot_pages = [[] for _ in range(self.num_slots)]
+            self._block_tables[:] = 0
+            self._d_block_tables = jnp.asarray(self._block_tables)
+            self._tables_dirty = False
+        else:
+            ck, cv = self.family.init_kv_cache(self.cfg, self.num_slots,
+                                               self.slot_capacity)
+            ck_sh, cv_sh = self.family.kv_cache_shardings(self.cfg, self.mesh)
         self.cache_k = jax.device_put(ck, ck_sh)
         self.cache_v = jax.device_put(cv, cv_sh)
         self._seq_lens[:] = 0
@@ -632,6 +793,7 @@ class EngineCore:
         if self.prefix_cache is not None:
             # the rebuilt cache holds zeros; every pinned prefix is gone
             self.prefix_cache.clear()
+        self._prefix_pinned_pages = 0
 
     # Same-bucket pending prompts prefill TOGETHER in one dispatch (padded to
     # a power-of-two group so the jit cache stays at log2 sizes). Bounded so
@@ -641,7 +803,8 @@ class EngineCore:
 
     def _free_slots(self) -> list[int]:
         """Slots available for new requests: unoccupied and not pinned as
-        prefix-cache donors."""
+        prefix-cache donors (dense mode only — paged donors pin pages, not
+        slots, so pinned_slots() is empty there and every idle slot serves)."""
         pinned = (self.prefix_cache.pinned_slots()
                   if self.prefix_cache is not None else ())
         return [
@@ -649,11 +812,110 @@ class EngineCore:
             if s.request is None and i not in pinned
         ]
 
+    # -------------------------------------------------------------- page pool
+
+    def _pages_for_tokens(self, n: int) -> int:
+        return -(-n // self.kv_page_size)
+
+    def _try_reserve_pages(self, count: int) -> list[int] | None:
+        """Alloc `count` fresh pages, evicting prefix-cache pages LRU under
+        pool pressure. None (no side effects beyond the evictions) when the
+        pool still cannot cover the request."""
+        if count <= 0:
+            return []
+        while True:
+            pages = self.page_pool.alloc(count)
+            if pages is not None:
+                return pages
+            if self.prefix_cache is None or not self._evict_one_prefix():
+                return None
+
+    def _assign_slot_pages(self, slot_id: int, shared, fresh) -> None:
+        """Install a slot's block-table row: `shared` donor pages first
+        (zero-copy prefix reuse — the slot takes a reference on each, no KV
+        bytes move), then `fresh` pages (refcount 1 from alloc, owned)."""
+        for p in shared:
+            self.page_pool.ref(p)
+        row = list(shared) + list(fresh)
+        self._slot_pages[slot_id] = row
+        self._block_tables[slot_id, :] = 0
+        self._block_tables[slot_id, :len(row)] = row
+        self._tables_dirty = True
+
+    def _extend_slot_pages(self, slot_id: int, fresh: list[int]) -> None:
+        row = self._slot_pages[slot_id]
+        start = len(row)
+        row.extend(fresh)
+        self._block_tables[slot_id, start:start + len(fresh)] = fresh
+        self._tables_dirty = True
+
+    def _free_slot_kv(self, slot_id: int) -> None:
+        """Return a slot's pages to the pool (shared prefix pages just drop
+        this slot's reference; the donor entry keeps them alive) and point
+        its table row at the trash page so the batched decode step's ongoing
+        garbage writes for the freed row can never land in a page a new
+        owner holds."""
+        if self.page_pool is None:
+            return
+        pages = self._slot_pages[slot_id]
+        if pages:
+            for p in pages:
+                self.page_pool.unref(p)
+            self._slot_pages[slot_id] = []
+            self._block_tables[slot_id, :] = 0
+            self._tables_dirty = True
+
+    def _sync_block_tables(self) -> None:
+        """Refresh the device block tables before a dispatch that reads them
+        (one small H2D, only when a row changed since the last sync)."""
+        if self._tables_dirty:
+            self._d_block_tables = jnp.asarray(self._block_tables)
+            self._tables_dirty = False
+
+    def _ensure_decode_pages(self, active: list[int], k: int) -> list[int]:
+        """Alloc-on-extend before a decode dispatch: grow each active row's
+        page list to cover the k tokens the dispatch writes. Under pool
+        exhaustion prefix-cache pages are evicted first; if the pool STILL
+        cannot cover a row, that request finishes with 'length' — the step
+        loop must never crash or deadlock on a full pool. Returns the rows
+        that remain active."""
+        kept = []
+        for i in active:
+            slot = self.slots[i]
+            target = min(int(self._seq_lens[i]) + k + 1, self.slot_capacity)
+            need = self._pages_for_tokens(target) - len(self._slot_pages[i])
+            if need > 0:
+                fresh = self._try_reserve_pages(need)
+                if fresh is None:
+                    request = slot.request
+                    log.warning(
+                        "page pool exhausted mid-decode; finishing request "
+                        "%s at %d tokens", request.request_id,
+                        int(self._seq_lens[i]),
+                    )
+                    request.finished_at = time.monotonic()
+                    request.events.put(("done", "length"))
+                    self.metrics.record_request_done("length")
+                    self._cancelled_effective.discard(request.request_id)
+                    self._free_slot_kv(i)
+                    slot.request = None
+                    slot.generated = 0
+                    slot.last_emit_at = 0.0
+                    slot.first_pending = False
+                    continue
+                self._extend_slot_pages(i, fresh)
+            kept.append(i)
+        return kept
+
     def _try_insert(self) -> bool:
         free = self._free_slots()
-        if not free and self.prefix_cache is not None and len(self.prefix_cache):
-            # Slot pressure: live traffic beats cached prefixes. Evict the
-            # LRU donor so a queued request is never starved by the cache.
+        if (not free and self.page_pool is None
+                and self.prefix_cache is not None and len(self.prefix_cache)):
+            # Slot pressure (dense only): live traffic beats cached prefixes —
+            # evict the LRU donor so a queued request is never starved by the
+            # cache. Paged donors never pin slots, so evicting here could not
+            # free one and would just drain the warm cache for nothing; paged
+            # PAGE pressure has its own eviction path in _try_reserve_pages.
             if self.pending.qsize() > 0 and self._evict_one_prefix():
                 free = self._free_slots()
         if not free:
@@ -663,10 +925,15 @@ class EngineCore:
         inserted = 0  # long inserts count toward the group cap too
         batch: list[tuple[int, Request, int]] = []  # (slot_id, request, n)
         while free and len(batch) + inserted < self.MAX_PREFILL_GROUP:
-            try:
-                request = self.pending.get_nowait()
-            except queue.Empty:
-                break
+            if self._held_request is not None:
+                # a request the page pool could not cover last tick retries
+                # ahead of newer arrivals (preserves FIFO order)
+                request, self._held_request = self._held_request, None
+            else:
+                try:
+                    request = self.pending.get_nowait()
+                except queue.Empty:
+                    break
             if self._is_cancelled(request):
                 request.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
@@ -693,12 +960,42 @@ class EngineCore:
                 hit = self.prefix_cache.match(request.prompt_ids,
                                              max_len=n - 1)
                 if hit is not None and not self._prefer_cp_over(hit[1], n):
-                    self._insert_cached(free.pop(0), request, hit[0], hit[1])
+                    entry, use_len = hit
+                    fresh: list[int] | None = None
+                    if self.page_pool is not None:
+                        # zero-copy hit: the shared head rides the donor's
+                        # pages; only the suffix needs fresh ones. The donor
+                        # must be pinned ACROSS the reservation — its LRU
+                        # eviction inside _try_reserve_pages would free the
+                        # very pages we are about to share (and could hand
+                        # them back as the "fresh" suffix pages).
+                        self.prefix_cache.acquire(entry)
+                        shared = use_len // self.kv_page_size
+                        fresh = self._try_reserve_pages(
+                            self._pages_for_tokens(n) - shared
+                        )
+                        self.prefix_cache.release(entry)
+                        if fresh is None:
+                            self._held_request = request  # queue on the pool
+                            break
+                        # no eviction point between the release above and
+                        # _insert_cached's re-acquire (same thread, no pool
+                        # ops in between), so the donor cannot vanish here
+                    self._insert_cached(free.pop(0), request, entry, use_len,
+                                        fresh)
                     handled = True
                     inserted += 1
                     continue
                 self.metrics.record_prefix_miss()
+            pages: list[int] | None = None
+            if self.page_pool is not None:
+                pages = self._try_reserve_pages(self._pages_for_tokens(n))
+                if pages is None:
+                    self._held_request = request  # queue on the pool
+                    break
             slot_id = free.pop(0)
+            if self.page_pool is not None:
+                self._assign_slot_pages(slot_id, (), pages)
             if n > max_oneshot:
                 heavy = self._insert_long(slot_id, request, n)
                 handled = True
@@ -768,14 +1065,23 @@ class EngineCore:
         )
 
     def _insert_cached(self, slot_id: int, request: Request,
-                       entry: PrefixEntry, use_len: int) -> None:
-        """Prefix-cache hit insert: copy `use_len` cached KV rows from the
-        donor slot into `slot_id` on device, then let _advance_prefill
-        chunk-prefill only the uncached suffix (prefill_pos starts at
-        use_len). The entry stays acquired until activation/cancellation so
-        its donor slot cannot be evicted and reused mid-flight."""
-        # Claim the slot BEFORE the copy dispatch (same invariant as the
-        # batch path): a failed dispatch then reaches this request through
+                       entry: PrefixEntry, use_len: int,
+                       fresh_pages: list[int] | None = None) -> None:
+        """Prefix-cache hit insert, then _advance_prefill chunk-prefills only
+        the uncached suffix (prefill_pos starts at use_len).
+
+        Paged mode is ZERO-COPY: the donor's page ids for the matched head go
+        straight into this slot's block table with a refcount bump
+        (`fresh_pages`, reserved by the caller, cover the suffix) — no device
+        dispatch at all. Dense mode copies `use_len` KV rows from the pinned
+        donor slot with one device-side dynamic_update_slice per cache.
+
+        The entry stays acquired until activation/cancellation so the donor
+        cannot be evicted and reused mid-flight (paged hits hold their own
+        page references too, but the acquire keeps eviction accounting
+        identical across layouts)."""
+        # Claim the slot BEFORE any dispatch (same invariant as the batch
+        # path): a failed dispatch then reaches this request through
         # _fail_all — which also releases cache_entry — instead of leaving
         # its event queue silent forever.
         slot = self.slots[slot_id]
@@ -791,14 +1097,19 @@ class EngineCore:
         self._d_seq_lens = self._d_seq_lens.at[slot_id].set(
             self.slot_capacity - 1
         )
-        rows = 1
-        while rows < use_len:
-            rows *= 2
-        rows = min(rows, self.slot_capacity)
-        self.cache_k, self.cache_v = _copy_kv_prefix(
-            self.cache_k, self.cache_v,
-            jnp.int32(entry.slot), jnp.int32(slot_id), rows,
-        )
+        if self.page_pool is not None:
+            shared = entry.pages[: use_len // self.kv_page_size]
+            self._assign_slot_pages(slot_id, shared, fresh_pages or [])
+        else:
+            rows = 1
+            while rows < use_len:
+                rows *= 2
+            rows = min(rows, self.slot_capacity)
+            self.cache_k, self.cache_v = _copy_kv_prefix(
+                self.cache_k, self.cache_v,
+                jnp.int32(entry.slot), jnp.int32(slot_id), rows,
+            )
+            self.kv_copy_dispatches += 1
         self.metrics.record_prefix_hit(use_len)
 
     def _release_cache_entry(self, slot: _Slot) -> None:
@@ -807,18 +1118,27 @@ class EngineCore:
                 self.prefix_cache.release(slot.cache_entry)
             slot.cache_entry = None
 
+    def _release_entry_pages(self, entry: PrefixEntry) -> None:
+        """Drop the prefix cache's page references of a removed entry."""
+        if self.page_pool is not None and entry.pages:
+            for p in entry.pages:
+                self.page_pool.unref(p)
+            self._prefix_pinned_pages -= len(entry.pages)
+
     def _evict_one_prefix(self) -> bool:
-        freed = self.prefix_cache.evict_lru()
-        if freed is None:
+        entry = self.prefix_cache.evict_lru_entry()
+        if entry is None:
             return False  # every donor has an in-flight reader
+        self._release_entry_pages(entry)
         self.metrics.record_prefix_eviction()
         return True
 
     def _maybe_cache_prefix(self, slot_id: int, request: Request) -> None:
-        """On request completion: pin this slot as a prefix donor when the
-        prompt's bucket-aligned head is long enough and not already covered.
-        The slot is NOT freed on success — _free_slots excludes pinned donors
-        until eviction returns them."""
+        """On request completion: donate this request's prompt KV when the
+        aligned head is long enough and not already covered. Dense mode pins
+        the whole slot (it leaves the serving pool until eviction); paged
+        mode pins only the PAGES covering the head — the slot itself frees
+        immediately, which is the occupancy win of the paged layout."""
         cache = self.prefix_cache
         n = len(request.prompt_ids)
         length = (n // cache.align) * cache.align
@@ -835,8 +1155,21 @@ class EngineCore:
         # multi-turn traffic this fires once per turn — charging it to
         # evictions_total would make the donor-churn signal operators alert
         # on track plain insertion rate.
-        cache.evict_subsumed(tokens)
+        for stale in cache.evict_subsumed_entries(tokens):
+            self._release_entry_pages(stale)
         if len(cache) >= cache.max_entries and not self._evict_one_prefix():
+            return
+        if self.page_pool is not None:
+            pages = tuple(
+                self._slot_pages[slot_id][: length // self.kv_page_size]
+            )
+            if not pages:
+                return
+            if cache.insert(tokens, -1, pages=pages) is not None:
+                for p in pages:  # the cache is now a co-owner of the head
+                    self.page_pool.ref(p)
+                self._prefix_pinned_pages += len(pages)
+                self.metrics.record_prefix_insert(length)
             return
         if cache.insert(tokens, slot_id) is not None:
             self.metrics.record_prefix_insert(length)
@@ -846,17 +1179,73 @@ class EngineCore:
         if self.prefix_cache is None:
             return {"enabled": False}
         pinned = len(self.prefix_cache)
-        # a pinned donor holds its whole slot row out of the serving pool
-        slot_bytes = kv_cache_bytes(self.cfg, 1, self.slot_capacity)
-        return {
+        info = {
             "enabled": True,
             "entries": pinned,
-            "pinned_slots": pinned,
             "budget_slots": self.prefix_cache.max_entries,
             "cached_tokens": self.prefix_cache.cached_tokens(),
-            "pinned_hbm_bytes": pinned * slot_bytes,
             "min_prefix_len": self.min_prefix_len,
             "align": self.prefix_align,
+        }
+        if self.page_pool is not None:
+            # zero-copy donors pin pages, never slots; HBM held is per page
+            info["pinned_slots"] = 0
+            info["pinned_pages"] = self._prefix_pinned_pages
+            info["pinned_hbm_bytes"] = (
+                self._prefix_pinned_pages
+                * kv_pool_bytes(self.cfg, 1, self.kv_page_size)
+            )
+        else:
+            # a pinned donor holds its whole slot row out of the serving pool
+            info["pinned_slots"] = pinned
+            info["pinned_hbm_bytes"] = (
+                pinned * kv_cache_bytes(self.cfg, 1, self.slot_capacity)
+            )
+        return info
+
+    def kv_cache_info(self) -> dict:
+        """KV memory block for /api/system, /api/health, and /metrics: the
+        dense footprint, or live page-pool utilization when paged. Gauge
+        reads are approximate under concurrent step-loop mutation (same
+        stance as every other scrape-time figure)."""
+        if self.page_pool is None:
+            return {
+                "layout": "dense",
+                "num_slots": self.num_slots,
+                "slot_capacity": self.slot_capacity,
+                "hbm_bytes": kv_cache_bytes(self.cfg, self.num_slots,
+                                            self.slot_capacity),
+            }
+        pool = self.page_pool
+        active = 0
+        active_pages = 0
+        waste = 0
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            active += 1
+            held = len(self._slot_pages[i])
+            active_pages += held
+            used = s.prefill_pos if s.prefilling else int(self._seq_lens[i])
+            waste += max(0, held * self.kv_page_size - used)
+        return {
+            "layout": "paged",
+            "page_size": self.kv_page_size,
+            "num_slots": self.num_slots,
+            "slot_capacity": self.slot_capacity,
+            "pages_total": pool.total,
+            "pages_free": pool.available(),
+            "pages_active": active_pages,
+            "pages_pinned": self._prefix_pinned_pages,
+            "utilization": round(pool.used() / max(1, pool.total), 4),
+            # allocated-but-unfilled cells of occupied rows: the internal
+            # fragmentation the --kv-page-size knob trades against
+            "fragmentation": round(
+                waste / max(1, active_pages * self.kv_page_size), 4
+            ),
+            "waste_tokens_mean": (round(waste / active, 1) if active else 0.0),
+            "hbm_bytes": kv_pool_bytes(self.cfg, self.kv_num_pages,
+                                       self.kv_page_size),
         }
 
     def _prefill_group(self, bucket: int,
@@ -880,16 +1269,30 @@ class EngineCore:
         slot_ids[g:] = slot_ids[g - 1]
 
         prefill_start = time.monotonic()
-        logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
-            self.params,
-            self.cfg,
-            jnp.asarray(ids),
-            jnp.asarray(lens),
-            jnp.asarray(slot_ids),
-            self.cache_k,
-            self.cache_v,
-            self.mesh,
-        )
+        if self.page_pool is not None:
+            # padding rows repeat the last real slot's table row, so their
+            # duplicate scatters rewrite identical cells (same trick as ids)
+            logits, self.cache_k, self.cache_v = self.family.prefill_into_pages(
+                self.params,
+                self.cfg,
+                jnp.asarray(ids),
+                jnp.asarray(lens),
+                jnp.asarray(self._block_tables[slot_ids]),
+                self.cache_k,
+                self.cache_v,
+                self.mesh,
+            )
+        else:
+            logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
+                self.params,
+                self.cfg,
+                jnp.asarray(ids),
+                jnp.asarray(lens),
+                jnp.asarray(slot_ids),
+                self.cache_k,
+                self.cache_v,
+                self.mesh,
+            )
         # jitted prefill returns futures (async dispatch); block before timing
         # or the histogram records dispatch overhead, not device execution.
         jax.block_until_ready(logits)
@@ -969,9 +1372,15 @@ class EngineCore:
         # KV beyond n is padding garbage; it lands in cells past the valid
         # length (masked by decode attention and overwritten as the sequence
         # grows into them) — same contract as the chunked path.
-        self.cache_k, self.cache_v = _scatter_kv_row(
-            self.cache_k, self.cache_v, k_all, v_all, jnp.int32(slot_id)
-        )
+        if self.page_pool is not None:
+            self.cache_k, self.cache_v = _scatter_kv_row_paged(
+                self.cache_k, self.cache_v, k_all, v_all,
+                jnp.asarray(self._block_tables[slot_id]),
+            )
+        else:
+            self.cache_k, self.cache_v = _scatter_kv_row(
+                self.cache_k, self.cache_v, k_all, v_all, jnp.int32(slot_id)
+            )
         slot = self.slots[slot_id]
         slot.request = request
         slot.generated = 0
@@ -995,6 +1404,7 @@ class EngineCore:
             self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
             self._release_cache_entry(slot)
+            self._free_slot_kv(slot_id)
             slot.request = None
             slot.prefilling = False
             slot.generated = 0
@@ -1009,17 +1419,30 @@ class EngineCore:
         ids[0, :chunk_len] = request.prompt_ids[start:start + chunk_len]
 
         prefill_start = time.monotonic()
-        logits, self.cache_k, self.cache_v = self.family.prefill_extend_slots(
-            self.params,
-            self.cfg,
-            jnp.asarray(ids),
-            jnp.asarray([chunk_len], np.int32),
-            jnp.asarray([start], np.int32),
-            jnp.asarray([slot_id], np.int32),
-            self.cache_k,
-            self.cache_v,
-            self.mesh,
-        )
+        if self.page_pool is not None:
+            logits, self.cache_k, self.cache_v = self.family.prefill_extend_pages(
+                self.params,
+                self.cfg,
+                jnp.asarray(ids),
+                jnp.asarray([chunk_len], np.int32),
+                jnp.asarray([start], np.int32),
+                jnp.asarray(self._block_tables[slot_id:slot_id + 1]),
+                self.cache_k,
+                self.cache_v,
+                self.mesh,
+            )
+        else:
+            logits, self.cache_k, self.cache_v = self.family.prefill_extend_slots(
+                self.params,
+                self.cfg,
+                jnp.asarray(ids),
+                jnp.asarray([chunk_len], np.int32),
+                jnp.asarray([start], np.int32),
+                jnp.asarray([slot_id], np.int32),
+                self.cache_k,
+                self.cache_v,
+                self.mesh,
+            )
         jax.block_until_ready(logits)  # async dispatch; time real execution
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
 
@@ -1057,8 +1480,34 @@ class EngineCore:
         """Jit a k-step decode: lax.scan feeds each step's sampled tokens
         back into the next ON DEVICE, so the host syncs once per k tokens
         instead of once per token. Sampling params are scan-invariant;
-        the caches are donated (the scan carries them in place)."""
+        the caches are donated (the scan carries them in place). The paged
+        variant additionally threads the (scan-invariant) block tables —
+        _ensure_decode_pages pre-allocates every page the burst will write."""
         family, cfg, mesh = self.family, self.cfg, self.mesh
+
+        if self.page_pool is not None:
+            def many(params, last, lens, cache_k, cache_v, tables,
+                     temps, top_ps, top_ks, key):
+                keys = jax.random.split(key, k)
+
+                def body(carry, step_key):
+                    last, lens, ck, cv = carry
+                    logits, ck, cv = family.decode_step_paged(
+                        params, cfg, last, lens, ck, cv, tables, mesh,
+                        window=window,
+                    )
+                    toks = sample_tokens(logits, step_key, temps, top_ps,
+                                         top_ks)
+                    return (toks, lens + 1, ck, cv), toks
+
+                first_in = last  # pre-burst tokens: pending first emissions
+                (last, lens, cache_k, cache_v), toks = jax.lax.scan(
+                    body, (last, lens, cache_k, cache_v), keys
+                )
+                toks = jnp.concatenate([first_in[None, :], toks], axis=0)
+                return last, lens, cache_k, cache_v, toks
+
+            return jax.jit(many, donate_argnums=(3, 4))
 
         def many(params, last, lens, cache_k, cache_v,
                  temps, top_ps, top_ks, key):
@@ -1103,17 +1552,34 @@ class EngineCore:
             self.metrics.set_batch_occupancy(0)
             return False
 
+        if self.page_pool is not None:
+            # alloc-on-extend: every page this dispatch writes must exist
+            # before the tables ship to the device
+            active = self._ensure_decode_pages(active, self.decode_burst)
+            if not active:
+                self.metrics.set_batch_occupancy(0)
+                return True  # pool exhaustion finished requests: work done
+            self._sync_block_tables()
+
         self._key, sk = jax.random.split(self._key)
         k = self.decode_burst
         if k > 1:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
-            (self._d_last_tokens, self._d_seq_lens, self.cache_k,
-             self.cache_v, toks_dev) = self._decode_many_for(window)(
-                self.params, self._d_last_tokens, self._d_seq_lens,
-                self.cache_k, self.cache_v,
-                self._d_temps, self._d_top_ps, self._d_top_ks, sk,
-            )
+            if self.page_pool is not None:
+                (self._d_last_tokens, self._d_seq_lens, self.cache_k,
+                 self.cache_v, toks_dev) = self._decode_many_for(window)(
+                    self.params, self._d_last_tokens, self._d_seq_lens,
+                    self.cache_k, self.cache_v, self._d_block_tables,
+                    self._d_temps, self._d_top_ps, self._d_top_ks, sk,
+                )
+            else:
+                (self._d_last_tokens, self._d_seq_lens, self.cache_k,
+                 self.cache_v, toks_dev) = self._decode_many_for(window)(
+                    self.params, self._d_last_tokens, self._d_seq_lens,
+                    self.cache_k, self.cache_v,
+                    self._d_temps, self._d_top_ps, self._d_top_ks, sk,
+                )
             tokens = self._fetch_tokens(toks_dev)  # ONE D2H sync per k tokens
             # Tokens reach the host back-to-back, so wall-clock gaps between
             # _emit calls are ~0 and would poison the ITL histogram; record
@@ -1125,16 +1591,29 @@ class EngineCore:
 
         step_start = time.monotonic()
         first_in = self._d_last_tokens  # pre-step tokens: pending firsts
-        logits, self.cache_k, self.cache_v = self.family.decode_step(
-            self.params,
-            self.cfg,
-            self._d_last_tokens,
-            self._d_seq_lens,
-            self.cache_k,
-            self.cache_v,
-            self.mesh,
-            window=self._window_for(active, 1),
-        )
+        if self.page_pool is not None:
+            logits, self.cache_k, self.cache_v = self.family.decode_step_paged(
+                self.params,
+                self.cfg,
+                self._d_last_tokens,
+                self._d_seq_lens,
+                self.cache_k,
+                self.cache_v,
+                self._d_block_tables,
+                self.mesh,
+                window=self._window_for(active, 1),
+            )
+        else:
+            logits, self.cache_k, self.cache_v = self.family.decode_step(
+                self.params,
+                self.cfg,
+                self._d_last_tokens,
+                self._d_seq_lens,
+                self.cache_k,
+                self.cache_v,
+                self.mesh,
+                window=self._window_for(active, 1),
+            )
         tokens_dev = sample_tokens(
             logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks
         )
@@ -1184,6 +1663,7 @@ class EngineCore:
             request.events.put(("done", "cancelled"))
             self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
+            self._free_slot_kv(slot_id)
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
@@ -1224,26 +1704,33 @@ class EngineCore:
             if self.prefix_cache is not None:
                 # Donor retention: the freed slot's rows [0, prompt_len) hold
                 # exactly the prompt's KV — pin them for prefix reuse instead
-                # of discarding (the slot stays out of the free pool until
-                # evicted LRU or under slot pressure).
+                # of discarding. Dense mode retains the whole slot (out of
+                # the free pool until evicted); paged mode pins only the
+                # head's pages and the slot frees immediately below.
                 self._maybe_cache_prefix(slot_id, request)
+            self._free_slot_kv(slot_id)
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False
 
     def _fail_all(self, message: str) -> None:
-        for slot in self.slots:
+        for slot_id, slot in enumerate(self.slots):
             if slot.request is not None:
                 slot.request.events.put(("error", message))
                 self.metrics.record_request_done("error")
                 slot.request = None
             self._release_cache_entry(slot)
+            self._free_slot_kv(slot_id)
             slot.prefilling = False
             slot.prefill_pos = 0
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False
+        if self._held_request is not None:
+            self._held_request.events.put(("error", message))
+            self.metrics.record_request_done("error")
+            self._held_request = None
         while True:
             try:
                 self.pending.get_nowait().events.put(("error", message))

@@ -264,6 +264,7 @@ class EngineAPI:
         text = core.metrics.render(
             queue_depth=stats.queued, active_slots=stats.active_slots,
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
+            kv_cache=core.kv_cache_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -277,6 +278,9 @@ class EngineAPI:
                 "tpu_engine": True,
                 "model": self.engine.model_id,
                 "prefix_cache": self.engine.core.prefix_cache_info(),
+                # paged mode reports live page-pool utilization; dense mode
+                # the static slot-cache footprint
+                "kv_cache": self.engine.core.kv_cache_info(),
             }
         )
 
@@ -735,6 +739,26 @@ def main(argv: list[str] | None = None) -> None:
              "8 on TPU, 1 elsewhere; also via LLMLB_DECODE_BURST)",
     )
     parser.add_argument(
+        "--kv-layout", choices=("paged", "dense"), default=None,
+        help="KV cache layout (default paged; also via LLMLB_KV_LAYOUT): "
+             "'paged' backs all slots with one shared page pool + block "
+             "tables so HBM is held per token cached; 'dense' reserves "
+             "slot-capacity rows per slot (the pre-paging layout, bit for "
+             "bit)",
+    )
+    parser.add_argument(
+        "--kv-page-size", type=int, default=None,
+        help="tokens per KV page in paged mode (default 128; see "
+             "docs/kv-cache.md for the waste-vs-overhead tradeoff)",
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=None,
+        help="total pages in the paged pool (default: num_slots x "
+             "slot_capacity worth — the dense HBM budget; raise num_slots "
+             "against the same pool to serve more concurrent short "
+             "requests)",
+    )
+    parser.add_argument(
         "--prefix-cache", choices=("on", "off"), default=None,
         help="radix-tree prefix KV reuse across requests (default on; "
              "also via LLMLB_PREFIX_CACHE=0)",
@@ -773,6 +797,12 @@ def main(argv: list[str] | None = None) -> None:
         extra["prefill_buckets"] = buckets
     if args.decode_burst is not None:
         extra["decode_burst"] = max(1, args.decode_burst)
+    if args.kv_layout is not None:
+        extra["kv_layout"] = args.kv_layout
+    if args.kv_page_size is not None:
+        extra["kv_page_size"] = max(1, args.kv_page_size)
+    if args.kv_pages is not None:
+        extra["kv_pages"] = max(2, args.kv_pages)
     if args.prefix_cache is not None:
         extra["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_slots is not None:

@@ -305,6 +305,7 @@ class Engine:
             },
             "tpu": device_telemetry(),
             "prefix_cache": self.core.prefix_cache_info(),
+            "kv_cache": self.core.kv_cache_info(),
             "metrics": self.core.metrics.summary(),
         }
 

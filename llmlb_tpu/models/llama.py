@@ -208,6 +208,48 @@ def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh, rules: ShardingRules | None
 
 
 # ---------------------------------------------------------------------------
+# Paged KV cache (global pool: [L, P_pages, page_size, K, D] + block tables)
+# ---------------------------------------------------------------------------
+
+def init_kv_pages(
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Global page pool shared by every slot: a slot's logical row is the
+    concatenation of the pool pages its block table names. Page 0 is the
+    engine's trash page (see engine/paging.py)."""
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim_)
+    dtype = dtype or cfg.dtype
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def kv_pages_shardings(cfg: LlamaConfig, mesh: Mesh,
+                       rules: ShardingRules | None = None):
+    """Pages are shared across slots, so the page axis cannot shard over dp
+    the way dense slots do (one sequence's pages must stay co-resident);
+    only the kv-head axis splits (tp), pages replicate over dp."""
+    rules = rules or shard_rules_for(cfg, mesh.shape["tp"])
+    sharding = logical_to_sharding(
+        mesh, rules, "layers", None, "seq", "kv_heads", "head_dim"
+    )
+    return (sharding, sharding)
+
+
+def make_write_kv_pages(block_tables: jnp.ndarray, page_size: int):
+    """KV write that scatters token rows through the block table into the
+    global page pool — the paged counterpart of make_write_kv_slots.
+    `positions` are logical per-row positions; page block_tables[b, p//PS],
+    offset p%PS is the physical cell."""
+
+    def write_kv(pool, kv, positions):
+        page = jnp.take_along_axis(block_tables, positions // page_size,
+                                   axis=1)  # [B, T]
+        return pool.at[page, positions % page_size].set(kv)
+
+    return write_kv
+
+
+# ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
@@ -476,6 +518,165 @@ def prefill_extend_slots(
         params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
         cache_k, cache_v,
     )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_into_pages(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded
+    prompt_lens: jnp.ndarray,  # [B] int32
+    block_tables: jnp.ndarray,  # [B, PPN] int32 — target pages per prompt
+    cache_k: jnp.ndarray,  # [L, P, PS, K, D] — the engine's live page pool
+    cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
+):
+    """Prefill B prompts and scatter their KV through the block tables into
+    the global page pool — the paged counterpart of prefill_into_slots.
+    Returns (last_logits [B, V] fp32, cache_k, cache_v)."""
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v,
+        make_write_kv_pages(block_tables, cache_k.shape[2]),
+    )
+
+
+def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
+                               block_tables, cache_k, cache_v, *,
+                               stacked_names=None, mlp_fn=_default_mlp_fn):
+    """Paged counterpart of _prefill_extend_impl: the chunk's KV scatters
+    through the block table into the page pool and attention reads the pool
+    via ops.attention.paged_attention_extend. Padding tokens write garbage
+    past the chunk — into this row's own later pages or the trash page
+    (unallocated table entries), never another row's cells."""
+    from llmlb_tpu.ops.attention import paged_attention_extend
+
+    _, t = input_ids.shape
+    ps = cache_k.shape[2]
+    capacity = block_tables.shape[1] * ps
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    offs = jnp.arange(t, dtype=jnp.int32)[None, :]
+    positions = start_pos[:, None] + offs  # [B, T] global positions
+    write_pos = jnp.minimum(positions, capacity - 1)
+    page = jnp.take_along_axis(block_tables, write_pos // ps, axis=1)
+    off = write_pos % ps
+    token_valid = offs < chunk_lens[:, None]  # [B, T]
+
+    x = params["embed"][input_ids]  # [B, T, E]
+    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+
+    def layer(carry_x, layer_in):
+        lp, ck, cv = layer_in
+
+        def attn_fn(q, k, v):
+            nonlocal ck, cv  # pool write precedes attention over the pool
+            ck = ck.at[page, off].set(k.astype(ck.dtype))
+            cv = cv.at[page, off].set(v.astype(cv.dtype))
+            return paged_attention_extend(
+                q, ck, cv, block_tables, positions, chunk_lens
+            )
+
+        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
+        h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid)
+        return carry_x, (ck, cv)
+
+    x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
+
+    last = jnp.maximum(chunk_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
+    logits = _unembed(cfg, params, x_last)
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_extend_pages(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, T] int32, right-padded chunk
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid tokens in this chunk
+    start_pos: jnp.ndarray,  # [B] int32 — tokens already in the row's pages
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    cache_k: jnp.ndarray,  # [L, P, PS, K, D]
+    cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
+):
+    """Paged chunked prefill: append a chunk of prompt tokens to rows that
+    already hold `start_pos` tokens, attending over everything so far
+    through the block tables. Same contract as prefill_extend_slots."""
+    return _prefill_extend_paged_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, block_tables,
+        cache_k, cache_v,
+    )
+
+
+def _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
+                       block_tables, *, stacked_names=None,
+                       mlp_fn=_default_mlp_fn, window=None):
+    """Paged counterpart of _decode_impl (same unrolled layer loop — see
+    that docstring for why decode never scans the cache). Each layer's
+    one-token KV lands at page block_tables[b, pos//PS], offset pos%PS;
+    freed/parked rows clamp into their own last cell or the trash page
+    (their block-table rows are zeroed on free), so garbage writes can
+    never land in a page another row owns."""
+    from llmlb_tpu.ops.attention import paged_attention_decode
+
+    b = input_ids.shape[0]
+    ps = cache_k.shape[2]
+    capacity = block_tables.shape[1] * ps
+    inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    write_pos = jnp.minimum(seq_lens, capacity - 1)
+    positions = write_pos[:, None]  # [B, 1]
+    batch_idx = jnp.arange(b)
+    page = block_tables[batch_idx, write_pos // ps]  # [B]
+    off = write_pos % ps
+
+    x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
+    names = stacked_names or _layer_stacked_names(cfg)
+
+    for layer_idx in range(cfg.num_layers):
+        lp = {n: params[n][layer_idx] for n in names}
+
+        def attn_fn(q, k, v, layer_idx=layer_idx):
+            nonlocal cache_k, cache_v  # write precedes attention over the pool
+            cache_k = cache_k.at[layer_idx, page, off].set(
+                k[:, 0].astype(cache_k.dtype)
+            )
+            cache_v = cache_v.at[layer_idx, page, off].set(
+                v[:, 0].astype(cache_v.dtype)
+            )
+            return paged_attention_decode(
+                q, cache_k[layer_idx], cache_v[layer_idx], block_tables,
+                write_pos + 1, window=window,
+            )
+
+        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn)
+        h = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
+        x = x + mlp_fn(lp, h, None)
+
+    logits = _unembed(cfg, params, x[:, 0])
+    return logits, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
+def decode_step_paged(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B] int32 — previous sampled token per row
+    seq_lens: jnp.ndarray,  # [B] int32 — tokens already in the row's pages
+    cache_k: jnp.ndarray,  # [L, P, PS, K, D]
+    cache_v: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    mesh: Mesh | None = None,  # unused; shared family signature
+    window: int | None = None,  # static context-window bucket (≥ max seq+1)
+):
+    """One paged decode step across all rows. Returns (logits [B, V] fp32,
+    caches). Same contract as decode_step with the dense slot cache swapped
+    for the page pool + block tables."""
+    return _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k,
+                              cache_v, block_tables, window=window)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -24,9 +24,12 @@ from jax.sharding import Mesh
 from llmlb_tpu.models.llama import (
     LlamaConfig,
     _decode_impl,
+    _decode_paged_impl,
     _prefill_extend_impl,
+    _prefill_extend_paged_impl,
     _prefill_impl,
     _write_kv_fresh,
+    make_write_kv_pages,
     make_write_kv_slots,
 )
 from llmlb_tpu.ops.moe import default_capacity, moe_dense_exact, moe_dispatch_combine
@@ -115,8 +118,13 @@ def param_shardings(cfg: MixtralConfig, mesh: Mesh, rules=None):
     }
 
 
-# KV cache layout identical to llama's — reuse.
-from llmlb_tpu.models.llama import init_kv_cache, kv_cache_shardings  # noqa: E402,F401
+# KV cache layouts (dense slots + paged pool) identical to llama's — reuse.
+from llmlb_tpu.models.llama import (  # noqa: E402,F401
+    init_kv_cache,
+    init_kv_pages,
+    kv_cache_shardings,
+    kv_pages_shardings,
+)
 
 
 _STACKED = ["wq", "wk", "wv", "wo", "router", "we_gate", "we_up", "we_down",
@@ -223,6 +231,50 @@ def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_
     tokens depend on which other slots share the batch."""
     return _decode_impl(
         params, cfg, input_ids, seq_lens, cache_k, cache_v,
+        stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
+        window=window,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
+                       block_tables, cache_k, cache_v,
+                       mesh: Mesh | None = None):
+    """Paged insert path. Same contract as llama.prefill_into_pages."""
+    b, t = input_ids.shape
+    return _prefill_impl(
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v,
+        make_write_kv_pages(block_tables, cache_k.shape[2]),
+        stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"),
+         donate_argnames=("cache_k", "cache_v"))
+def prefill_extend_pages(params, cfg: MixtralConfig, input_ids, chunk_lens,
+                         start_pos, block_tables, cache_k, cache_v,
+                         mesh: Mesh | None = None):
+    """Paged chunked-prefill append. Same contract as llama.prefill_extend_pages."""
+    b, t = input_ids.shape
+    return _prefill_extend_paged_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, block_tables,
+        cache_k, cache_v,
+        stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
+def decode_step_paged(params, cfg: MixtralConfig, input_ids, seq_lens,
+                      cache_k, cache_v, block_tables,
+                      mesh: Mesh | None = None, window: int | None = None):
+    """One paged decode step. Same contract as llama.decode_step_paged;
+    exact MoE for the same batch-independence reason as decode_step."""
+    return _decode_paged_impl(
+        params, cfg, input_ids, seq_lens, cache_k, cache_v, block_tables,
         stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
         window=window,
     )

@@ -3,6 +3,8 @@ from llmlb_tpu.ops.rope import apply_rope, rope_frequencies
 from llmlb_tpu.ops.attention import (
     gqa_attention_prefill,
     gqa_attention_decode,
+    paged_attention_decode,
+    paged_attention_extend,
 )
 from llmlb_tpu.ops.sampling import sample_tokens
 
@@ -12,5 +14,7 @@ __all__ = [
     "rope_frequencies",
     "gqa_attention_prefill",
     "gqa_attention_decode",
+    "paged_attention_decode",
+    "paged_attention_extend",
     "sample_tokens",
 ]

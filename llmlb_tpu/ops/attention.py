@@ -127,6 +127,69 @@ def gqa_attention_extend(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
+def gather_kv_pages(pages: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """Materialize contiguous per-row KV from the page pool: [P, PS, K, D]
+    gathered by block tables [B, N] -> [B, N*PS, K, D]. This is the XLA
+    fallback path (CPU tests / partitioned meshes) — on an unpartitioned TPU
+    the Pallas paged kernels index the pool through the block table instead
+    and never build this copy."""
+    b, n = tables.shape
+    _, ps, k, d = pages.shape
+    return pages[tables].reshape(b, n * ps, k, d)
+
+
+def paged_attention_decode(
+    q: jnp.ndarray,  # [B, 1, H, D]
+    k_pages: jnp.ndarray,  # [P, PS, K, D] — global page pool
+    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    kv_lens: jnp.ndarray,  # [B] int32 — valid logical length per row
+    window: int | None = None,  # static: read only the first `window` cells
+) -> jnp.ndarray:
+    """One-token decode attention against the PAGED KV pool. Same contract
+    as gqa_attention_decode — `window` (STATIC) bounds the logical sweep,
+    rounded up to whole pages; rows with kv_lens beyond the swept pages
+    produce garbage the caller must discard (parked/freed slot rows)."""
+    ps = k_pages.shape[1]
+    ppn = block_tables.shape[1]
+    pages = ppn if window is None else max(1, min(ppn, -(-window // ps)))
+    if _pallas_enabled():
+        from llmlb_tpu.ops.pallas_attention import paged_flash_decode
+
+        return paged_flash_decode(
+            q[:, 0], k_pages, v_pages, block_tables, kv_lens, pages=pages
+        )[:, None]
+    tables = block_tables[:, :pages] if pages < ppn else block_tables
+    k_cache = gather_kv_pages(k_pages, tables)
+    v_cache = gather_kv_pages(v_pages, tables)
+    return gqa_attention_decode(q, k_cache, v_cache, kv_lens)
+
+
+def paged_attention_extend(
+    q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
+    k_pages: jnp.ndarray,  # [P, PS, K, D]
+    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    q_positions: jnp.ndarray,  # [B, T] int32 — global position of each query
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid queries in the chunk
+) -> jnp.ndarray:
+    """Chunked-prefill attention against the PAGED KV pool: the chunk's
+    queries attend causally over row b's pages (earlier chunks + this
+    chunk). Paged counterpart of gqa_attention_extend; assumes the engine's
+    contiguous chunk positions (q_positions[b] = start + iota)."""
+    if _pallas_enabled():
+        from llmlb_tpu.ops.pallas_attention import paged_flash_extend
+
+        return paged_flash_extend(
+            q, k_pages, v_pages, block_tables, q_positions[:, 0], chunk_lens
+        )
+    k_cache = gather_kv_pages(k_pages, block_tables)
+    v_cache = gather_kv_pages(v_pages, block_tables)
+    # chunk_lens=None pins gqa_attention_extend to the XLA einsum path (the
+    # caches are already materialized dense here).
+    return gqa_attention_extend(q, k_cache, v_cache, q_positions, None)
+
+
 def gqa_attention_decode(
     q: jnp.ndarray,  # [B, 1, H, D]
     k_cache: jnp.ndarray,  # [B, S, K, D] — slot-capacity cache incl. current token

@@ -195,6 +195,93 @@ def flash_decode(
 
 
 # ---------------------------------------------------------------------------
+# Paged decode: q [B, H, D] vs page pool [P, PS, K, D], block tables [B, PPN]
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(block_tables_ref, kv_lens_ref, *refs, **kw):
+    """Same online-softmax sweep as _decode_kernel; the block-table ref is
+    consumed by the BlockSpec index_map (it picks which POOL page each grid
+    step DMAs), so the body only needs the ragged lengths."""
+    del block_tables_ref
+    _decode_kernel(kv_lens_ref, *refs, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("pages", "interpret"))
+def paged_flash_decode(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [P, PS, K, D] — global page pool
+    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    block_tables: jnp.ndarray,  # [B, PPN] int32 — logical page i of row b
+    kv_lens: jnp.ndarray,  # [B] int32 — valid logical length per row
+    *,
+    pages: int | None = None,  # static: sweep only the first `pages` pages
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Ragged PAGED one-token GQA decode attention. Returns [B, H, D].
+
+    The grid is (batch, logical_page) and the KV BlockSpec index_map gathers
+    each step's page THROUGH the prefetched block table
+    (`block_tables[b, i]` picks the pool row to DMA) — attention reads the
+    scattered pool directly, no contiguous per-row copy is ever
+    materialized. `pages` plays the role of flash_decode's `window`: the
+    sweep stops after that many logical pages and rows whose kv_lens extend
+    beyond produce garbage the caller must discard (parked/freed slot rows).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    num_kv = k_pages.shape[2]
+    g = h // num_kv
+    ppn = block_tables.shape[1]
+    sweep = ppn if pages is None else max(1, min(pages, ppn))
+    qg = q.reshape(b, num_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, sweep),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_kv, g, d),
+                lambda bi, si, tables, lens: (bi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps, num_kv, d),
+                lambda bi, si, tables, lens: (tables[bi, si], 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps, num_kv, d),
+                lambda bi, si, tables, lens: (tables[bi, si], 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_kv, g, d),
+            lambda bi, si, tables, lens: (bi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, block_k=ps, num_kv=num_kv, scale=d**-0.5
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
 # Prefill: causal q [B, T, H, D] vs fresh k/v [B, T, K, D], ragged prompt_lens
 # ---------------------------------------------------------------------------
 
@@ -488,4 +575,98 @@ def flash_extend(
         interpret=interpret,
     )(start_pos.astype(jnp.int32), chunk_lens.astype(jnp.int32),
       qg, k_cache, v_cache)
+    return out.reshape(b, t, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Paged extend (chunked prefill): q chunk [B, T, H, D] vs page pool
+# [P, PS, K, D] through block tables [B, PPN]; chunk starts at start_pos[b].
+# ---------------------------------------------------------------------------
+
+
+def _paged_extend_kernel(block_tables_ref, start_pos_ref, chunk_lens_ref,
+                         *refs, **kw):
+    """Same masked sweep as _extend_kernel; logical KV position of grid step
+    `ki` is ki * page_size because the index_map walks the block table in
+    logical order — the body never needs the table itself."""
+    del block_tables_ref
+    _extend_kernel(start_pos_ref, chunk_lens_ref, *refs, **kw)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def paged_flash_extend(
+    q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
+    k_pages: jnp.ndarray,  # [P, PS, K, D] — global page pool
+    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    start_pos: jnp.ndarray,  # [B] int32 — global position of the first query
+    chunk_lens: jnp.ndarray,  # [B] int32 — valid queries (rest are padding)
+    *,
+    block_q: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Paged chunked-prefill attention: T contiguous queries starting at
+    global position start_pos[b] attend causally over row b's pages (earlier
+    chunks + this chunk), gathered through the prefetched block table by the
+    KV BlockSpec index_map. KV blocks entirely in the future of the chunk
+    skip their FLOPs (`pl.when` in _extend_kernel), so cost scales with the
+    context actually filled, not pool capacity. Returns [B, T, H, D]."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    ps = k_pages.shape[1]
+    num_kv = k_pages.shape[2]
+    g = h // num_kv
+    ppn = block_tables.shape[1]
+    blk_q = min(block_q, t)
+    grid = (b, pl.cdiv(t, blk_q), ppn)
+    qg = q.reshape(b, t, num_kv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, blk_q, num_kv, g, d),
+                lambda bi, qi, si, tables, starts, lens: (bi, qi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps, num_kv, d),
+                lambda bi, qi, si, tables, starts, lens:
+                    (tables[bi, si], 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, ps, num_kv, d),
+                lambda bi, qi, si, tables, starts, lens:
+                    (tables[bi, si], 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, num_kv, g, d),
+            lambda bi, qi, si, tables, starts, lens: (bi, qi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_extend_kernel,
+            block_q=blk_q,
+            block_k=ps,
+            num_kv=num_kv,
+            groups=g,
+            scale=d**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, t, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), start_pos.astype(jnp.int32),
+      chunk_lens.astype(jnp.int32), qg, k_pages, v_pages)
     return out.reshape(b, t, h, d)

@@ -37,6 +37,18 @@ import re
 import sys
 import time
 
+
+def _pin_platform() -> None:
+    """On CPU-only hosts jax's TPU backend init hangs ~30 s per retry inside
+    make_c_api_client (BENCH_r05 tail); decide from host evidence BEFORE the
+    first device touch. Shares bench.py's detection so both harnesses agree."""
+    sys.path.insert(0, ".")
+    from bench import force_cpu_platform, tpu_possibly_present
+
+    if not tpu_possibly_present():
+        force_cpu_platform("no TPU evidence on this host; "
+                           "set LLMLB_BENCH_FORCE_TPU_PROBE=1 to override")
+
 _SAMPLE_RE = re.compile(
     r"^([A-Za-z_:][A-Za-z0-9_:]*)\{(.*)\}\s+(-?[0-9.eE+]+)$"
 )
@@ -302,17 +314,125 @@ async def run_prefix_bench(requests: int) -> dict:
         engine.shutdown()
 
 
+async def run_mixed_length_bench(requests_n: int) -> dict:
+    """Paged-vs-dense occupancy at EQUAL HBM budget: one pool worth of KV
+    serves a mixed short/long workload under both layouts. Dense reserves
+    slot_capacity rows per slot, capping concurrency at its slot count;
+    paged holds pages per token actually cached, so the same bytes admit
+    many more short requests at once. Reports peak concurrent sequences per
+    layout and confirms the page-pool gauges are visible in /metrics."""
+    import random
+
+    import aiohttp
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.scheduler import SamplingParams
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+
+    capacity, page = 256, 16
+    dense_slots = 4
+    results: dict = {}
+    for layout in ("dense", "paged"):
+        kwargs = dict(
+            num_slots=dense_slots, slot_capacity=capacity,
+            prefill_buckets=(16, 32, 64), kv_layout=layout,
+            kv_page_size=page,
+        )
+        if layout == "paged":
+            # same pool bytes as the dense cache (+1 trash page); the extra
+            # slots are bookkeeping only — HBM does not grow with them
+            kwargs["kv_pages"] = dense_slots * (capacity // page) + 1
+            kwargs["num_slots"] = dense_slots * 4
+        engine = Engine.from_preset("debug-tiny", **kwargs)
+        eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await eng_server.start_server()
+        try:
+            r = random.Random(0)
+            prompts = []
+            for i in range(requests_n):
+                # 1-in-4 long prompts; the rest are short chats that would
+                # each strand a full slot row under the dense layout
+                n = 200 if i % 4 == 0 else 12
+                prompts.append([r.randrange(1, 500) for _ in range(n)])
+
+            peak = 0
+            done = False
+
+            async def sample() -> None:
+                nonlocal peak
+                while not done:
+                    peak = max(peak, engine.core.stats().active_slots)
+                    await asyncio.sleep(0.002)
+
+            sampler = asyncio.create_task(sample())
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(*(
+                engine.complete(p, SamplingParams(temperature=0.0,
+                                                  max_tokens=8))
+                for p in prompts
+            ))
+            elapsed = time.perf_counter() - t0
+            done = True
+            await sampler
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{eng_server.port}/metrics"
+                ) as resp:
+                    exposition = await resp.text()
+            info = engine.core.kv_cache_info()
+            results[layout] = {
+                "num_slots": engine.core.num_slots,
+                "kv_hbm_bytes": info["hbm_bytes"],
+                "peak_concurrent_sequences": peak,
+                "seconds": round(elapsed, 2),
+                "finished": sum(
+                    1 for o in outs if o.finish_reason in ("stop", "length")
+                ),
+                "page_gauges_in_metrics": (
+                    "llmlb_engine_kv_pages_total" in exposition
+                    if layout == "paged" else None
+                ),
+                "kv_cache": info,
+            }
+        finally:
+            await eng_server.close()
+            engine.shutdown()
+    dense_b = results["dense"]["kv_hbm_bytes"]
+    paged_b = results["paged"]["kv_hbm_bytes"]
+    return {
+        "metric": "paged_vs_dense_mixed_length_occupancy",
+        "requests": requests_n,
+        # paged may carry the one reserved trash page of extra HBM
+        "equal_hbm_budget": abs(paged_b - dense_b) <= dense_b // dense_slots,
+        "peak_concurrency_gain": round(
+            results["paged"]["peak_concurrent_sequences"]
+            / max(1, results["dense"]["peak_concurrent_sequences"]), 2
+        ),
+        "dense": results["dense"],
+        "paged": results["paged"],
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--concurrency", type=int, default=50)
-    parser.add_argument("--workload", choices=("proxy", "shared-prefix"),
-                        default="proxy")
+    parser.add_argument(
+        "--workload", choices=("proxy", "shared-prefix", "mixed-length"),
+        default="proxy",
+    )
     parser.add_argument("--requests", type=int, default=24,
-                        help="request count for --workload shared-prefix")
+                        help="request count for --workload shared-prefix / "
+                             "mixed-length")
     args = parser.parse_args()
+    if args.workload != "proxy":
+        _pin_platform()  # engine workloads touch jax: decide platform first
     if args.workload == "shared-prefix":
         result = asyncio.run(run_prefix_bench(args.requests))
+    elif args.workload == "mixed-length":
+        result = asyncio.run(run_mixed_length_bench(args.requests))
     else:
         result = asyncio.run(run_bench(args.seconds, args.concurrency))
     print(json.dumps(result))

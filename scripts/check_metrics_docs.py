@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Cross-check exported metric names against docs/monitoring/README.md.
+
+Every Prometheus series the engine and gateway registries can emit must be
+named VERBATIM somewhere in docs/monitoring/README.md — new gauges (like the
+page-pool family) cannot ship undocumented. Wired as a tier-1 test
+(tests/test_metrics_docs.py); also runnable standalone:
+
+    python scripts/check_metrics_docs.py
+
+Enumeration is by rendering the real registries (with every optional block
+enabled and one sample recorded per labeled family, so conditional series
+render too) plus the scrape-time gauge/counter literals the gateway /metrics
+handler injects (regex over llmlb_tpu/gateway/app.py — they live in a dict
+at the call site, not in the registry).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs" / "monitoring" / "README.md"
+
+_TYPE_RE = re.compile(r"^# TYPE (\S+) ", re.MULTILINE)
+_GATEWAY_LITERAL_RE = re.compile(r'"(llmlb_gateway_[a-z0-9_]+)"')
+
+
+def engine_metric_names() -> set[str]:
+    from llmlb_tpu.engine.metrics import EngineMetrics
+
+    m = EngineMetrics()
+    text = m.render(
+        queue_depth=0, active_slots=0, num_slots=1,
+        prefix_cache={
+            "enabled": True, "entries": 0, "pinned_slots": 0,
+            "pinned_pages": 0, "pinned_hbm_bytes": 0,
+        },
+        kv_cache={
+            "layout": "paged", "page_size": 128, "pages_total": 0,
+            "pages_free": 0, "pages_active": 0, "pages_pinned": 0,
+            "utilization": 0.0, "fragmentation": 0.0,
+            "waste_tokens_mean": 0.0,
+        },
+    )
+    return set(_TYPE_RE.findall(text))
+
+
+def gateway_metric_names() -> set[str]:
+    from llmlb_tpu.gateway.metrics import GatewayMetrics
+
+    g = GatewayMetrics()
+    # one sample per labeled family so every series renders
+    g.record_request("/v1/chat/completions", 500)
+    g.record_retry("chat")
+    g.record_queue_timeout("m")
+    g.record_ttft("m", "e", 0.1)
+    g.record_e2e("m", "e", 0.1)
+    g.record_queue_wait("m", "e", 0.1)
+    names = set(_TYPE_RE.findall(g.render()))
+    # scrape-time gauges/counters injected by the /metrics handler
+    app_src = (REPO / "llmlb_tpu" / "gateway" / "app.py").read_text()
+    names |= set(_GATEWAY_LITERAL_RE.findall(app_src))
+    return names
+
+
+def undocumented(names: set[str], docs_text: str) -> list[str]:
+    return sorted(n for n in names if n not in docs_text)
+
+
+def main() -> int:
+    docs_text = DOCS.read_text()
+    missing = undocumented(engine_metric_names() | gateway_metric_names(),
+                           docs_text)
+    if missing:
+        print("metric names exported but not documented in "
+              f"{DOCS.relative_to(REPO)}:", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+        return 1
+    print("all exported metric names are documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(REPO))
+    raise SystemExit(main())

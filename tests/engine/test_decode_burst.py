@@ -222,7 +222,9 @@ def test_prefill_dispatch_failure_reaches_batched_requests(cfg):
     core.family = type("F", (), {
         **{k: staticmethod(getattr(core.family, k))
            for k in dir(core.family) if not k.startswith("__")},
+        # both layouts' insert paths fail (paged is the default layout)
         "prefill_into_slots": staticmethod(boom),
+        "prefill_into_pages": staticmethod(boom),
     })()
     core.start()
     try:

@@ -11,11 +11,14 @@ from llmlb_tpu.engine.server import create_engine_app
 from llmlb_tpu.engine.service import Engine
 
 
-@pytest.fixture(scope="module")
-def engine():
+# The whole serving contract runs over BOTH KV layouts: paged (default —
+# shared page pool + block tables) and dense (the original slot cache).
+@pytest.fixture(scope="module", params=["paged", "dense"])
+def engine(request):
     eng = Engine.from_preset(
         "debug-tiny", num_slots=4, slot_capacity=64,
         prefill_buckets=(16, 32), seed=0,
+        kv_layout=request.param, kv_page_size=16,
     )
     yield eng
     eng.shutdown()

@@ -175,12 +175,26 @@ def prompt():
     return list(rng.integers(1, cfg.vocab_size, size=(48,)))
 
 
-def test_cache_hit_reuses_prefix_and_matches_cold_output(prompt):
+# Every engine-core test runs over BOTH KV layouts: dense (pinned donor
+# slots + device-side row copies) and paged (zero-copy page sharing). The
+# 16-token page size matches the prefill bucket so aligned lengths — and
+# every counter assertion below — are identical across layouts.
+@pytest.fixture(params=["dense", "paged"])
+def kv_layout(request):
+    return request.param
+
+
+def make_core(kv_layout, **kw):
+    kw.setdefault("kv_page_size", 16)
+    return EngineCore(get_preset("debug-tiny"), kv_layout=kv_layout, **kw)
+
+
+def test_cache_hit_reuses_prefix_and_matches_cold_output(prompt, kv_layout):
     """Warm identical prompt: hit counters move, cached tokens are the
     aligned head, and greedy output equals the cold run's (the copied KV
     rows are the same numbers the cold prefill computed)."""
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), seed=0)
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), seed=0)
     core.start()
     try:
         cold_toks, cold_fin = _run(core, prompt)
@@ -200,9 +214,9 @@ def test_cache_hit_reuses_prefix_and_matches_cold_output(prompt):
         core.stop()
 
 
-def test_divergent_tail_still_hits_shared_head(prompt):
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), seed=0)
+def test_divergent_tail_still_hits_shared_head(prompt, kv_layout):
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), seed=0)
     core.start()
     try:
         _run(core, prompt)
@@ -215,13 +229,14 @@ def test_divergent_tail_still_hits_shared_head(prompt):
         core.stop()
 
 
-def test_slot_pressure_evicts_donors_for_live_traffic():
+def test_slot_pressure_evicts_donors_for_live_traffic(kv_layout):
     """With every non-pinned slot busy and requests queued, pinned donors
-    are evicted LRU rather than starving the queue."""
+    are evicted LRU rather than starving the queue (dense); in paged mode
+    the same budget bound churns ENTRIES instead of slots."""
     cfg = get_preset("debug-tiny")
     rng = np.random.default_rng(3)
-    core = EngineCore(cfg, num_slots=2, slot_capacity=64,
-                      prefill_buckets=(16,), prefix_cache_slots=1, seed=0)
+    core = make_core(kv_layout, num_slots=2, slot_capacity=64,
+                     prefill_buckets=(16,), prefix_cache_slots=1, seed=0)
     core.start()
     try:
         prompts = [list(rng.integers(1, cfg.vocab_size, size=(20,)))
@@ -253,13 +268,13 @@ def _drive_to_completion(core, request, limit=500):
     raise AssertionError("request did not finish")
 
 
-def test_cancel_mid_suffix_prefill_releases_entry(prompt):
+def test_cancel_mid_suffix_prefill_releases_entry(prompt, kv_layout):
     """A cache-hit request cancelled during its suffix prefill must release
     the donor entry (refcount back to 0) so it stays evictable. Driven
     inline — the loop thread is never started — so the cancellation lands
     exactly between the KV-row copy and the first suffix chunk."""
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), seed=0)
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), seed=0)
     # warm the cache with one completed request
     kind, _ = _drive_to_completion(
         core, Request(prompt_ids=list(prompt),
@@ -282,12 +297,12 @@ def test_cancel_mid_suffix_prefill_releases_entry(prompt):
     assert core.prefix_cache.evict_lru() is not None  # evictable again
 
 
-def test_multi_turn_conversation_reuses_one_donor_slot(prompt):
+def test_multi_turn_conversation_reuses_one_donor_slot(prompt, kv_layout):
     """Growing-conversation shape: each turn extends the last prompt. The
     cache must hold ONE entry for the conversation (ancestors reclaimed),
-    not one pinned slot per turn."""
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), prefix_cache_slots=3, seed=0)
+    not one pinned slot (or page set) per turn."""
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), prefix_cache_slots=3, seed=0)
     core.start()
     try:
         turn = list(prompt[:16])
@@ -316,9 +331,9 @@ def test_env_var_disables_prefix_cache(monkeypatch):
     assert core.prefix_cache is not None
 
 
-def test_disabled_flag_restores_plain_scheduler(prompt):
-    core = EngineCore(get_preset("debug-tiny"), num_slots=2, slot_capacity=64,
-                      prefill_buckets=(16,), prefix_cache=False, seed=0)
+def test_disabled_flag_restores_plain_scheduler(prompt, kv_layout):
+    core = make_core(kv_layout, num_slots=2, slot_capacity=64,
+                     prefill_buckets=(16,), prefix_cache=False, seed=0)
     core.start()
     try:
         assert core.prefix_cache is None
@@ -332,9 +347,9 @@ def test_disabled_flag_restores_plain_scheduler(prompt):
         core.stop()
 
 
-def test_prefix_metrics_in_prometheus_and_summary(prompt):
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), seed=0)
+def test_prefix_metrics_in_prometheus_and_summary(prompt, kv_layout):
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), seed=0)
     core.start()
     try:
         _run(core, prompt)
@@ -348,7 +363,12 @@ def test_prefix_metrics_in_prometheus_and_summary(prompt):
         assert "llmlb_engine_prefix_cache_misses_total 1" in text
         assert "llmlb_engine_prefix_cache_cached_tokens_total 32" in text
         assert "llmlb_engine_prefix_cache_evictions_total 0" in text
-        assert "llmlb_engine_prefix_cache_pinned_slots 1" in text
+        if kv_layout == "paged":
+            # zero-copy donors pin pages, never slots
+            assert "llmlb_engine_prefix_cache_pinned_slots 0" in text
+            assert "llmlb_engine_prefix_cache_pinned_pages 3" in text
+        else:
+            assert "llmlb_engine_prefix_cache_pinned_slots 1" in text
         assert "llmlb_engine_prefix_cache_pinned_hbm_bytes" in text
         summary = core.metrics.summary()
         assert summary["prefix_hits_total"] == 1
@@ -360,12 +380,14 @@ def test_prefix_metrics_in_prometheus_and_summary(prompt):
 # ----------------------------------------------------------------- perf smoke
 
 
-def test_cache_hit_skips_prefill_for_cached_region(prompt):
+def test_cache_hit_skips_prefill_for_cached_region(prompt, kv_layout):
     """Tier-1 regression guard: a hit must dispatch prefill steps ONLY for
     the uncached suffix. 48-token prompt over 16-token chunks: 3 dispatches
-    cold, exactly 1 warm (32 tokens ride the device-side row copy)."""
-    core = EngineCore(get_preset("debug-tiny"), num_slots=4, slot_capacity=64,
-                      prefill_buckets=(16,), seed=0)
+    cold, exactly 1 warm (32 tokens ride the device-side row copy in dense
+    mode, the donor's shared pages in paged mode — which must additionally
+    dispatch ZERO cache copies)."""
+    core = make_core(kv_layout, num_slots=4, slot_capacity=64,
+                     prefill_buckets=(16,), seed=0)
     core.start()
     try:
         m = core.metrics
@@ -379,6 +401,8 @@ def test_cache_hit_skips_prefill_for_cached_region(prompt):
             f"cache hit re-prefilled the cached region: {warm_steps} "
             f"dispatches for a 16-token suffix"
         )
+        if kv_layout == "paged":
+            assert core.kv_copy_dispatches == 0
     finally:
         core.stop()
 

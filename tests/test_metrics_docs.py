@@ -1,0 +1,38 @@
+"""Tier-1 guard: every exported metric name is documented.
+
+Runs scripts/check_metrics_docs.py's cross-check in-process: any series the
+engine or gateway registries can emit must appear verbatim in
+docs/monitoring/README.md, so new gauges (like the KV page-pool family)
+cannot ship undocumented.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import check_metrics_docs  # noqa: E402
+
+
+def test_engine_metrics_are_documented():
+    docs = check_metrics_docs.DOCS.read_text()
+    missing = check_metrics_docs.undocumented(
+        check_metrics_docs.engine_metric_names(), docs
+    )
+    assert not missing, f"undocumented engine metrics: {missing}"
+
+
+def test_gateway_metrics_are_documented():
+    docs = check_metrics_docs.DOCS.read_text()
+    missing = check_metrics_docs.undocumented(
+        check_metrics_docs.gateway_metric_names(), docs
+    )
+    assert not missing, f"undocumented gateway metrics: {missing}"
+
+
+def test_checker_catches_missing_names():
+    """The checker itself must fail on an undocumented name (no silent
+    vacuous pass if enumeration breaks)."""
+    assert check_metrics_docs.undocumented(
+        {"llmlb_engine_not_a_real_metric"}, check_metrics_docs.DOCS.read_text()
+    ) == ["llmlb_engine_not_a_real_metric"]
