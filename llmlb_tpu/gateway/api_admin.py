@@ -47,6 +47,7 @@ def endpoint_to_json(ep: Endpoint, models: list | None = None) -> dict:
         "base_url": ep.base_url,
         "endpoint_type": ep.endpoint_type.value,
         "status": ep.status.value,
+        "breaker_state": ep.breaker_state,
         "latency_ms": ep.latency_ms,
         "consecutive_failures": ep.consecutive_failures,
         "accelerator": {
@@ -187,9 +188,14 @@ async def update_endpoint(request: web.Request) -> web.Response:
 async def delete_endpoint(request: web.Request) -> web.Response:
     state = request.app["state"]
     endpoint_id = request.match_info["endpoint_id"]
+    ep = state.registry.get(endpoint_id)
     if not state.registry.remove(endpoint_id):
         return _json_error(404, "endpoint not found")
     state.load_manager.clear_tps_for_endpoint(endpoint_id)
+    state.load_manager.drop_endpoint_outcomes(endpoint_id)
+    if state.resilience is not None:
+        state.resilience.forget(endpoint_id,
+                                endpoint_name=ep.name if ep else None)
     state.events.publish("EndpointRemoved", {"endpoint_id": endpoint_id})
     return web.json_response({"deleted": endpoint_id})
 

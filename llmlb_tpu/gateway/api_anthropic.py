@@ -29,6 +29,14 @@ from llmlb_tpu.gateway.api_openai import (
     select_endpoint_with_queue,
 )
 from llmlb_tpu.gateway.balancer import prefix_affinity_hash
+from llmlb_tpu.gateway.resilience import (
+    RETRYABLE_EXCEPTIONS,
+    FailoverController,
+    PreStreamFailure,
+    book_stream_outcome,
+    retry_after_seconds,
+    upstream_post,
+)
 from llmlb_tpu.gateway.model_names import to_canonical
 from llmlb_tpu.gateway.token_accounting import estimate_tokens
 from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
@@ -47,11 +55,25 @@ STOP_REASON_MAP = {
 
 
 def _anthropic_error(status: int, message: str,
-                     err_type: str = "invalid_request_error") -> web.Response:
+                     err_type: str = "invalid_request_error",
+                     headers: dict | None = None) -> web.Response:
     return web.json_response(
         {"type": "error", "error": {"type": err_type, "message": message}},
         status=status,
+        headers=headers,
     )
+
+
+def anthropic_error_event(message: str,
+                          err_type: str = "api_error") -> bytes:
+    """Anthropic's native SSE error event (the real API emits exactly this
+    shape mid-stream), written before closing a cut stream so clients can
+    tell truncation from completion."""
+    payload = {"type": "error", "error": {"type": err_type,
+                                          "message": message}}
+    return (
+        f"event: error\ndata: {json.dumps(payload, separators=(',', ':'))}\n\n"
+    ).encode()
 
 
 # ------------------------------------------------- request/response convert
@@ -361,91 +383,180 @@ async def messages(request: web.Request) -> web.StreamResponse:
     if trace is not None:
         trace.model = canonical
     openai_body = anthropic_request_to_openai(body)
-    try:
-        selection = await select_endpoint_with_queue(
-            state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
-            trace=trace,
-            prefix_hash=prefix_affinity_hash(
-                canonical, affinity_text_from_body(body)
-            ),
-        )
-    except QueueTimeout:
-        return _anthropic_error(503, "all endpoints busy", "overloaded_error")
-    if selection is None:
-        return _anthropic_error(
-            404, f"model {model!r} is not available", "not_found_error"
-        )
-    endpoint, engine_model, lease = selection
-    openai_body["model"] = engine_model
+    prefix_hash = prefix_affinity_hash(
+        canonical, affinity_text_from_body(body)
+    )
     is_stream = bool(body.get("stream"))
     if is_stream:
         openai_body["stream"] = True
         openai_body["stream_options"] = {"include_usage": True}
 
-    headers = {"Content-Type": "application/json"}
-    if endpoint.api_key:
-        headers["Authorization"] = f"Bearer {endpoint.api_key}"
-    rid = request.get("request_id")
-    if rid:
-        headers[REQUEST_ID_HEADER] = rid
-    if trace is not None:
-        trace.begin("proxy")
-    try:
-        upstream = await state.http.post(
-            endpoint.url + "/v1/chat/completions",
-            json=openai_body,
-            headers=headers,
-            timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
-        )
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        lease.fail()
-        return _anthropic_error(
-            502, f"upstream unreachable: {type(e).__name__}", "api_error"
-        )
+    # Same failover loop as proxy_openai_post: re-select excluding failed
+    # endpoints, retry under the attempt cap + global budget; streams fail
+    # over only before the first Anthropic event reaches the client.
+    fo = FailoverController(
+        state, canonical, trace=trace,
+        candidates_fn=lambda: [
+            ep for ep, _ in state.registry.find_by_model(
+                canonical, Capability.CHAT_COMPLETION
+            )
+        ],
+    )
+    while True:
+        try:
+            selection = await select_endpoint_with_queue(
+                state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
+                trace=trace, prefix_hash=prefix_hash, exclude=fo.failed_ids,
+                queue_timeout_s=(fo.config.failover_queue_timeout_s
+                                 if fo.failed_ids else None),
+            )
+        except QueueTimeout:
+            return _anthropic_error(
+                503, "all endpoints busy", "overloaded_error",
+                headers={"Retry-After": str(retry_after_seconds(
+                    state, canonical, Capability.CHAT_COMPLETION
+                ))},
+            )
+        if selection is None:
+            return _anthropic_error(
+                404, f"model {model!r} is not available", "not_found_error"
+            )
+        endpoint, engine_model, lease = selection
+        openai_body["model"] = engine_model
 
-    if upstream.status != 200:
-        detail = (await upstream.read())[:1024].decode(errors="replace")
+        headers = {"Content-Type": "application/json"}
+        if endpoint.api_key:
+            headers["Authorization"] = f"Bearer {endpoint.api_key}"
+        rid = request.get("request_id")
+        if rid:
+            headers[REQUEST_ID_HEADER] = rid
+        if trace is not None:
+            trace.begin("proxy")
+        try:
+            upstream = await upstream_post(
+                state, endpoint, "/v1/chat/completions",
+                json=openai_body,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(
+                    total=state.config.inference_timeout_s
+                ),
+            )
+        except RETRYABLE_EXCEPTIONS as e:
+            reason = ("timeout" if isinstance(e, asyncio.TimeoutError)
+                      else "connect_error")
+            fo.record_failure(endpoint, lease, reason)
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry(reason):
+                continue
+            return _anthropic_error(
+                502, f"upstream unreachable: {type(e).__name__}", "api_error"
+            )
+
+        if upstream.status != 200:
+            status_code = upstream.status
+            try:
+                detail = (await upstream.read())[:1024].decode(errors="replace")
+            except RETRYABLE_EXCEPTIONS:
+                detail = "<error body unreadable>"
+            upstream.release()
+            if trace is not None:
+                trace.end("proxy")
+            if status_code in fo.config.retryable_statuses:
+                reason = f"http_{status_code}"
+                fo.record_failure(endpoint, lease, reason)
+                if await fo.should_retry(reason):
+                    continue
+            else:
+                # non-retryable 4xx: not endpoint sickness, but liveness
+                # evidence — resolves a half-open probe
+                lease.fail()
+                fo.record_alive(endpoint)
+            _record(state, endpoint=endpoint, model=canonical,
+                    api_kind=TpsApiKind.CHAT, path="/v1/messages", status=502,
+                    started=started, client_ip=request.remote,
+                    auth=request.get("auth"), error=detail)
+            return _anthropic_error(
+                502, f"upstream returned {status_code}: {detail}", "api_error"
+            )
+
+        if is_stream:
+            result = await _stream_transform(
+                request, state, upstream, endpoint, canonical, started, lease,
+                body, openai_body, trace=trace, failover=fo,
+            )
+            if isinstance(result, PreStreamFailure):
+                fo.record_failure(endpoint, lease, "stream_pre_byte")
+                if trace is not None:
+                    trace.end("proxy")
+                if await fo.should_retry("stream_pre_byte"):
+                    continue
+                return _anthropic_error(
+                    502,
+                    f"upstream stream failed before first byte: "
+                    f"{result.error}",
+                    "api_error",
+                )
+            return result
+
+        observe_first_token(state, trace, canonical, endpoint.name, started)
+        try:
+            raw = await upstream.read()
+        except RETRYABLE_EXCEPTIONS as e:
+            # endpoint died mid-body: invisible to the client, fails over
+            upstream.release()
+            fo.record_failure(endpoint, lease, "read_error")
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry("read_error"):
+                continue
+            return _anthropic_error(
+                502, f"upstream response read failed: {type(e).__name__}",
+                "api_error",
+            )
         upstream.release()
-        lease.fail()
+        if trace is not None:
+            trace.end("proxy")
+        try:
+            openai_resp = json.loads(raw)
+        except ValueError:
+            # the endpoint answered (malformed): alive, but not a success
+            lease.fail()
+            fo.record_alive(endpoint)
+            return _anthropic_error(
+                502, "invalid upstream response", "api_error"
+            )
+        anthropic_resp = openai_response_to_anthropic(openai_resp, model)
+        usage = anthropic_resp["usage"]
+        lease.complete_with_tokens(usage["input_tokens"],
+                                   usage["output_tokens"])
+        fo.record_success(endpoint)
         _record(state, endpoint=endpoint, model=canonical,
-                api_kind=TpsApiKind.CHAT, path="/v1/messages", status=502,
-                started=started, client_ip=request.remote,
-                auth=request.get("auth"), error=detail)
-        return _anthropic_error(
-            502, f"upstream returned {upstream.status}: {detail}", "api_error"
-        )
-
-    if is_stream:
-        return await _stream_transform(
-            request, state, upstream, endpoint, canonical, started, lease,
-            body, openai_body, trace=trace,
-        )
-
-    observe_first_token(state, trace, canonical, endpoint.name, started)
-    raw = await upstream.read()
-    upstream.release()
-    if trace is not None:
-        trace.end("proxy")
-    try:
-        openai_resp = json.loads(raw)
-    except ValueError:
-        lease.fail()
-        return _anthropic_error(502, "invalid upstream response", "api_error")
-    anthropic_resp = openai_response_to_anthropic(openai_resp, model)
-    usage = anthropic_resp["usage"]
-    lease.complete_with_tokens(usage["input_tokens"], usage["output_tokens"])
-    _record(state, endpoint=endpoint, model=canonical, api_kind=TpsApiKind.CHAT,
-            path="/v1/messages", status=200, started=started,
-            prompt_tokens=usage["input_tokens"],
-            completion_tokens=usage["output_tokens"],
-            client_ip=request.remote, auth=request.get("auth"))
-    return web.json_response(anthropic_resp)
+                api_kind=TpsApiKind.CHAT, path="/v1/messages", status=200,
+                started=started,
+                prompt_tokens=usage["input_tokens"],
+                completion_tokens=usage["output_tokens"],
+                client_ip=request.remote, auth=request.get("auth"))
+        return web.json_response(anthropic_resp)
 
 
 async def _stream_transform(
     request, state, upstream, endpoint, model, started, lease,
-    original_body, openai_body, trace=None,
-) -> web.StreamResponse:
+    original_body, openai_body, trace=None, failover=None,
+) -> "web.StreamResponse | PreStreamFailure":
+    # First upstream chunk is pulled BEFORE the client response is prepared:
+    # a failure there is invisible to the client and fails over.
+    iterator = upstream.content.iter_any()
+    first_chunk = None
+    try:
+        first_chunk = await iterator.__anext__()
+    except StopAsyncIteration:
+        first_chunk = None
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            ConnectionResetError) as e:
+        upstream.release()
+        return PreStreamFailure(f"{type(e).__name__}: {e}")
+
     headers = {"Content-Type": "text/event-stream"}
     rid = request.get("request_id")
     if rid:
@@ -465,38 +576,64 @@ async def _stream_transform(
         input_token_estimate=estimate_tokens(prompt_text),
     )
     buffer = b""
-    first_chunk = True
+    status = 200
+    error = None
+    upstream_failed = False
+
+    async def pump(raw_chunk: bytes) -> None:
+        nonlocal buffer
+        buffer += raw_chunk
+        while b"\n" in buffer:
+            line, buffer = buffer.split(b"\n", 1)
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            data = line[len(b"data:"):].strip()
+            if not data or data == b"[DONE]":
+                continue
+            try:
+                chunk = json.loads(data)
+            except ValueError:
+                continue
+            for event in encoder.feed(chunk):
+                await resp.write(event)
+
     try:
-        async for raw_chunk in upstream.content.iter_any():
-            if first_chunk:
-                first_chunk = False
-                observe_first_token(state, trace, model, endpoint.name,
-                                    started, streaming=True)
-            buffer += raw_chunk
-            while b"\n" in buffer:
-                line, buffer = buffer.split(b"\n", 1)
-                line = line.strip()
-                if not line.startswith(b"data:"):
-                    continue
-                data = line[len(b"data:"):].strip()
-                if not data or data == b"[DONE]":
-                    continue
+        if first_chunk is not None:
+            observe_first_token(state, trace, model, endpoint.name,
+                                started, streaming=True)
+            await pump(first_chunk)
+            while True:
                 try:
-                    chunk = json.loads(data)
-                except ValueError:
-                    continue
-                for event in encoder.feed(chunk):
-                    await resp.write(event)
-        for event in encoder.finish():
-            await resp.write(event)
+                    raw_chunk = await iterator.__anext__()
+                except StopAsyncIteration:
+                    break
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    # mid-stream upstream cut: native Anthropic error event,
+                    # then count it against the endpoint
+                    status = 502
+                    error = f"stream interrupted: {type(e).__name__}"
+                    upstream_failed = True
+                    await resp.write(anthropic_error_event(error))
+                    break
+                await pump(raw_chunk)
+        if not upstream_failed:
+            for event in encoder.finish():
+                await resp.write(event)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
-            ConnectionResetError):
-        pass
+            ConnectionResetError) as e:
+        # client went away mid-write: not endpoint sickness
+        status = 502
+        error = error or f"client disconnected: {type(e).__name__}"
     finally:
         upstream.release()
         if trace is not None:
             trace.end("decode")
             trace.end("proxy")
+        book_stream_outcome(state, failover, endpoint, model,
+                            upstream_failed=upstream_failed,
+                            completed=status == 200)
         ct = encoder.usage["output_tokens"]
         duration_s = time.monotonic() - started
         if ct:
@@ -504,14 +641,16 @@ async def _stream_transform(
                 endpoint.id, model, TpsApiKind.CHAT, ct, duration_s
             )
         _record(state, endpoint=endpoint, model=model, api_kind=TpsApiKind.CHAT,
-                path="/v1/messages", status=200, started=started,
+                path="/v1/messages", status=status, started=started,
                 prompt_tokens=encoder.usage["input_tokens"],
                 completion_tokens=ct, client_ip=request.remote,
-                auth=request.get("auth"), stream=True)
+                auth=request.get("auth"), error=error, stream=True)
     return resp
 
 
 async def _cloud_passthrough(request, state, body, model) -> web.StreamResponse:
+    from llmlb_tpu.gateway.api_cloud import cloud_post
+
     key = os.environ.get("ANTHROPIC_API_KEY")
     if not key:
         return _anthropic_error(
@@ -519,8 +658,8 @@ async def _cloud_passthrough(request, state, body, model) -> web.StreamResponse:
         )
     payload = dict(body)
     payload["model"] = model
-    upstream = await state.http.post(
-        ANTHROPIC_BASE + "/v1/messages",
+    upstream = await cloud_post(
+        state, "anthropic", ANTHROPIC_BASE + "/v1/messages",
         json=payload,
         headers={
             "x-api-key": key,

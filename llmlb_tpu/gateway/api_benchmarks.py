@@ -56,6 +56,10 @@ async def _run_single(state, model: str, prompt: str, max_tokens: int) -> dict:
     if selection is None:
         return {"ok": False, "error": "no endpoint", "endpoint_id": None}
     endpoint, engine_model, lease = selection
+    # Benchmarks go through the real admission machinery, so on a half-open
+    # breaker they consume the probe slot — every exit below must report an
+    # outcome to the resilience manager or that slot would stay wedged.
+    resilience = state.resilience
     headers = {}
     if endpoint.api_key:
         headers["Authorization"] = f"Bearer {endpoint.api_key}"
@@ -75,11 +79,21 @@ async def _run_single(state, model: str, prompt: str, max_tokens: int) -> dict:
             elapsed = time.monotonic() - start
             if resp.status != 200:
                 lease.fail()
+                if resilience is not None:
+                    if (resp.status in resilience.config.retryable_statuses
+                            and resp.status != 429):
+                        resilience.record_failure(endpoint.id,
+                                                  f"http_{resp.status}")
+                    else:
+                        resilience.record_success(endpoint.id)
                 return {"ok": False, "error": f"HTTP {resp.status}",
                         "endpoint_id": endpoint.id,
                         "latency_ms": elapsed * 1000}
             usage = extract_usage_from_response(body) or (0, 0)
             lease.complete_with_tokens(*usage)
+            if resilience is not None:
+                resilience.record_success(endpoint.id)
+            state.load_manager.note_endpoint_success(endpoint.id)
             return {
                 "ok": True, "endpoint_id": endpoint.id,
                 "latency_ms": elapsed * 1000,
@@ -88,6 +102,9 @@ async def _run_single(state, model: str, prompt: str, max_tokens: int) -> dict:
             }
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
         lease.fail()
+        if resilience is not None:
+            resilience.record_failure(endpoint.id, "connect_error")
+        state.load_manager.note_endpoint_failure(endpoint.id)
         return {"ok": False, "error": type(e).__name__,
                 "endpoint_id": endpoint.id,
                 "latency_ms": (time.monotonic() - start) * 1000}

@@ -20,6 +20,7 @@ import aiohttp
 from aiohttp import web
 
 from llmlb_tpu.gateway.api_openai import error_response
+from llmlb_tpu.gateway.resilience import RETRYABLE_EXCEPTIONS, backoff_delay
 
 OPENAI_BASE = os.environ.get("LLMLB_OPENAI_BASE_URL", "https://api.openai.com")
 GOOGLE_BASE = os.environ.get(
@@ -230,6 +231,55 @@ def _gemini_to_openai_response(body: dict, model: str) -> dict:
     }
 
 
+async def cloud_post(state, provider: str, url: str, *, json=None,
+                     headers=None, timeout=None):
+    """POST to a cloud provider with bounded retry + capped backoff on
+    connect errors and retryable statuses (5xx/429), spending the gateway's
+    global retry budget. No circuit breaker: cloud providers are not
+    registry endpoints, and there is no alternative to fail over to — this
+    is same-target retry only."""
+    resilience = state.resilience
+    cfg = (resilience.config
+           if resilience is not None and resilience.config.enabled else None)
+    if cfg is not None:
+        # fund the shared retry budget: cloud requests never build a
+        # FailoverController, and a cloud-heavy deployment must not starve
+        # local failover down to the budget's min floor
+        resilience.budget.note_request()
+    attempt = 1
+
+    def spend_retry(reason: str) -> bool:
+        nonlocal attempt
+        if cfg is None or attempt >= cfg.max_attempts:
+            return False
+        if not resilience.budget.try_spend():
+            # same bookkeeping as FailoverController: a budget-refused
+            # retry must show up in the exhaustion counter/alert
+            state.metrics.record_retry_budget_exhausted()
+            return False
+        state.metrics.record_failover_retry(f"cloud:{provider}", reason)
+        attempt += 1
+        return True
+
+    while True:
+        try:
+            upstream = await state.http.post(
+                url, json=json, headers=headers, timeout=timeout
+            )
+        except RETRYABLE_EXCEPTIONS:
+            if spend_retry("connect_error"):
+                await asyncio.sleep(backoff_delay(attempt - 1, cfg))
+                continue
+            raise
+        if cfg is not None and upstream.status in cfg.retryable_statuses:
+            reason = f"http_{upstream.status}"
+            if spend_retry(reason):
+                upstream.release()
+                await asyncio.sleep(backoff_delay(attempt - 1, cfg))
+                continue
+        return upstream
+
+
 # --------------------------------------------------------------- entry point
 
 
@@ -271,8 +321,8 @@ async def _proxy_openai_passthrough(
     """Same wire format: swap model + auth, stream or buffer verbatim."""
     payload = dict(body)
     payload["model"] = model
-    upstream = await state.http.post(
-        OPENAI_BASE + path,
+    upstream = await cloud_post(
+        state, "openai", OPENAI_BASE + path,
         json=payload,
         headers={"Authorization": f"Bearer {key}"},
         timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
@@ -302,8 +352,8 @@ async def _proxy_openai_passthrough(
 async def _proxy_anthropic(request, state, key, model, body) -> web.Response:
     payload = _openai_to_anthropic_request(body, model)
     payload.pop("stream", None)  # converted cloud path is non-streaming
-    upstream = await state.http.post(
-        ANTHROPIC_BASE + "/v1/messages",
+    upstream = await cloud_post(
+        state, "anthropic", ANTHROPIC_BASE + "/v1/messages",
         json=payload,
         headers={"x-api-key": key, "anthropic-version": "2023-06-01"},
         timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),
@@ -321,8 +371,8 @@ async def _proxy_anthropic(request, state, key, model, body) -> web.Response:
 
 async def _proxy_google(request, state, key, model, body) -> web.Response:
     payload = _openai_to_gemini_request(body)
-    upstream = await state.http.post(
-        f"{GOOGLE_BASE}/v1beta/models/{model}:generateContent",
+    upstream = await cloud_post(
+        state, "google", f"{GOOGLE_BASE}/v1beta/models/{model}:generateContent",
         json=payload,
         headers={"x-goog-api-key": key},
         timeout=aiohttp.ClientTimeout(total=state.config.inference_timeout_s),

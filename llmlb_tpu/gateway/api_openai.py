@@ -23,6 +23,14 @@ from aiohttp import web
 from llmlb_tpu.gateway.app_state import AppState, record_daily_stat
 from llmlb_tpu.gateway.balancer import RequestRecord, prefix_affinity_hash
 from llmlb_tpu.gateway.model_names import to_canonical, to_engine_name
+from llmlb_tpu.gateway.resilience import (
+    RETRYABLE_EXCEPTIONS,
+    FailoverController,
+    PreStreamFailure,
+    book_stream_outcome,
+    retry_after_seconds,
+    upstream_post,
+)
 from llmlb_tpu.gateway.sanitize import sanitize_request_body
 from llmlb_tpu.gateway.token_accounting import (
     StreamingTokenAccumulator,
@@ -38,10 +46,12 @@ CLOUD_PREFIXES = ("openai:", "google:", "anthropic:")
 
 
 def error_response(status: int, message: str,
-                   err_type: str = "invalid_request_error") -> web.Response:
+                   err_type: str = "invalid_request_error",
+                   headers: dict | None = None) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": err_type, "code": None}},
         status=status,
+        headers=headers,
     )
 
 
@@ -99,6 +109,7 @@ def affinity_text_from_body(body: dict) -> str:
 async def select_endpoint_with_queue(
     state: AppState, model: str, capability: Capability, api_kind: TpsApiKind,
     trace=None, prefix_hash: str | None = None,
+    exclude: set[str] | None = None, queue_timeout_s: float | None = None,
 ) -> tuple[Endpoint, str, "RequestLease"] | None:
     """Atomically TPS-select and lease an endpoint serving the model; if all
     are at the admission cap, park on the AdmissionQueue until a lease release
@@ -106,17 +117,28 @@ async def select_endpoint_with_queue(
     balancer/mod.rs:2273-2427). `prefix_hash` steers toward the endpoint
     whose engine-side prefix KV cache is warm for this prompt. Records
     admission/queue_wait/endpoint_select spans on `trace` and feeds the
-    gateway queue-wait histogram."""
+    gateway queue-wait histogram.
+
+    `exclude` drops endpoints that already failed this request (failover
+    re-selection); breaker-open endpoints are ejected inside the LoadManager
+    itself. Both reduce the candidate set, never the 404 decision: a model
+    whose endpoints are all excluded or breaker-open queues (and eventually
+    503s with queue semantics), it does not 404. `queue_timeout_s` overrides
+    the configured queue timeout (failover re-selection uses a short one)."""
     if not state.registry.find_by_model(model, capability):
         return None
 
     def get_endpoints() -> list[Endpoint]:
-        return [ep for ep, _ in state.registry.find_by_model(model, capability)]
+        return [
+            ep for ep, _ in state.registry.find_by_model(model, capability)
+            if not exclude or ep.id not in exclude
+        ]
 
     if trace is not None:
         trace.begin("admission")
     admit_start = time.monotonic()
     result = await state.admission.admit(get_endpoints, model, api_kind,
+                                         timeout_s=queue_timeout_s,
                                          prefix_hash=prefix_hash)
     if not result.admitted:
         state.metrics.record_queue_timeout(model)
@@ -231,126 +253,246 @@ async def proxy_openai_post(
         prefix_affinity_hash(canonical, affinity_text_from_body(body))
         if capability == Capability.CHAT_COMPLETION else None
     )
-    try:
-        selection = await select_endpoint_with_queue(
-            state, canonical, capability, api_kind, trace=trace,
-            prefix_hash=prefix_hash,
-        )
-    except QueueTimeout as qt:
-        return error_response(
-            503,
-            f"all endpoints busy; queue timeout exceeded "
-            f"(position {qt.queue_position})",
-            "server_error",
-        )
-    if selection is None:
-        return error_response(
-            404, f"model {model!r} is not available on any online endpoint",
-            "invalid_request_error",
-        )
-    endpoint, engine_model, lease = selection
-
-    payload = dict(body)
-    # registry knows the engine-local name; fall back to the static alias table
-    payload["model"] = engine_model or to_engine_name(
-        canonical, endpoint.endpoint_type.value
-    )
-    is_stream = bool(payload.get("stream"))
-    if is_stream:
-        # usage in the final chunk feeds the TPS tracker (api/openai.rs:981-992)
-        opts = dict(payload.get("stream_options") or {})
-        opts["include_usage"] = True
-        payload["stream_options"] = opts
-
-    headers = {"Content-Type": "application/json"}
-    if endpoint.api_key:
-        headers["Authorization"] = f"Bearer {endpoint.api_key}"
-    rid = request.get("request_id")
-    if rid:
-        # the engine scheduler adopts this id, joining the gateway trace
-        headers[REQUEST_ID_HEADER] = rid
-
     client_ip = request.remote
     auth = request.get("auth")
     prompt_text = prompt_text_fn(body) if prompt_text_fn else ""
     # stored for the dashboard request-detail view, inline media redacted
     # (the reference's sanitization contract, implemented)
     stored_body = sanitize_request_body(body)
+    is_stream = bool(body.get("stream"))
 
-    if trace is not None:
-        trace.begin("proxy")
-    try:
-        upstream = await state.http.post(
-            endpoint.url + path,
-            json=payload,
-            headers=headers,
-            timeout=aiohttp.ClientTimeout(
-                total=state.config.inference_timeout_s, sock_connect=10
-            ),
-        )
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        lease.fail()
-        _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
-                path=path, status=502, started=started, client_ip=client_ip,
-                auth=auth, error=f"{type(e).__name__}: {e}",
-                request_body=stored_body)
-        return error_response(
-            502, f"upstream endpoint unreachable: {type(e).__name__}",
-            "server_error",
-        )
-
-    if upstream.status != 200:
-        # normalize non-2xx upstream to 502 (api/openai.rs:1180)
-        detail = (await upstream.read())[:2048].decode(errors="replace")
-        upstream.release()
-        lease.fail()
-        _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
-                path=path, status=502, started=started, client_ip=client_ip,
-                auth=auth, error=f"upstream HTTP {upstream.status}: {detail}",
-                request_body=stored_body)
-        return error_response(
-            502, f"upstream returned {upstream.status}: {detail}", "server_error"
-        )
-
-    content_type = upstream.headers.get("Content-Type", "")
-    if is_stream and "text/event-stream" in content_type:
-        return await _forward_stream(
-            request, state, upstream, endpoint, canonical, api_kind, path,
-            started, lease, prompt_text, client_ip, auth, stored_body,
-            trace=trace,
-        )
-
-    observe_first_token(state, trace, canonical, endpoint.name, started)
-    raw = await upstream.read()
-    upstream.release()
-    if trace is not None:
-        trace.end("proxy")
-    try:
-        parsed = json.loads(raw)
-    except ValueError:
-        parsed = None
-    usage = extract_usage_from_response(parsed) if isinstance(parsed, dict) else None
-    if usage is None:
-        completion_text = _extract_completion_text(parsed) if parsed else ""
-        usage = (estimate_tokens(prompt_text), estimate_tokens(completion_text))
-    lease.complete_with_tokens(*usage)
-    _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
-            path=path, status=200, started=started,
-            prompt_tokens=usage[0], completion_tokens=usage[1],
-            client_ip=client_ip, auth=auth, request_body=stored_body)
-    state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
-    return web.Response(
-        body=raw, status=200,
-        content_type="application/json",
+    # Failover loop: each attempt re-selects (excluding endpoints that
+    # already failed this request), and a failed attempt retries on another
+    # endpoint with backoff while the attempt cap and global retry budget
+    # allow. Streams are retryable only until the first byte reaches the
+    # client (_forward_stream pulls the first upstream chunk before
+    # preparing the client response for exactly this reason).
+    fo = FailoverController(
+        state, canonical, trace=trace,
+        candidates_fn=lambda: [
+            ep for ep, _ in state.registry.find_by_model(canonical, capability)
+        ],
     )
+    while True:
+        try:
+            selection = await select_endpoint_with_queue(
+                state, canonical, capability, api_kind, trace=trace,
+                prefix_hash=prefix_hash, exclude=fo.failed_ids,
+                queue_timeout_s=(fo.config.failover_queue_timeout_s
+                                 if fo.failed_ids else None),
+            )
+        except QueueTimeout as qt:
+            return error_response(
+                503,
+                f"all endpoints busy; queue timeout exceeded "
+                f"(position {qt.queue_position})",
+                "server_error",
+                headers={"Retry-After": str(
+                    retry_after_seconds(state, canonical, capability)
+                )},
+            )
+        if selection is None:
+            return error_response(
+                404, f"model {model!r} is not available on any online endpoint",
+                "invalid_request_error",
+            )
+        endpoint, engine_model, lease = selection
+
+        payload = dict(body)
+        # registry knows the engine-local name; fall back to the static alias
+        # table
+        payload["model"] = engine_model or to_engine_name(
+            canonical, endpoint.endpoint_type.value
+        )
+        if is_stream:
+            # usage in the final chunk feeds the TPS tracker
+            # (api/openai.rs:981-992)
+            opts = dict(payload.get("stream_options") or {})
+            opts["include_usage"] = True
+            payload["stream_options"] = opts
+
+        headers = {"Content-Type": "application/json"}
+        if endpoint.api_key:
+            headers["Authorization"] = f"Bearer {endpoint.api_key}"
+        rid = request.get("request_id")
+        if rid:
+            # the engine scheduler adopts this id, joining the gateway trace
+            headers[REQUEST_ID_HEADER] = rid
+
+        if trace is not None:
+            trace.begin("proxy")
+        try:
+            upstream = await upstream_post(
+                state, endpoint, path,
+                json=payload,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(
+                    total=state.config.inference_timeout_s, sock_connect=10
+                ),
+            )
+        except RETRYABLE_EXCEPTIONS as e:
+            reason = ("timeout" if isinstance(e, asyncio.TimeoutError)
+                      else "connect_error")
+            fo.record_failure(endpoint, lease, reason)
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry(reason):
+                continue
+            _record(state, endpoint=endpoint, model=canonical,
+                    api_kind=api_kind, path=path, status=502, started=started,
+                    client_ip=client_ip, auth=auth,
+                    error=f"{type(e).__name__}: {e}",
+                    request_body=stored_body)
+            return error_response(
+                502, f"upstream endpoint unreachable: {type(e).__name__}",
+                "server_error",
+            )
+
+        if upstream.status != 200:
+            # normalize non-2xx upstream to 502 (api/openai.rs:1180)
+            status_code = upstream.status
+            try:
+                detail = (await upstream.read())[:2048].decode(errors="replace")
+            except RETRYABLE_EXCEPTIONS:
+                detail = "<error body unreadable>"
+            upstream.release()
+            if trace is not None:
+                trace.end("proxy")
+            if status_code in fo.config.retryable_statuses:
+                reason = f"http_{status_code}"
+                fo.record_failure(endpoint, lease, reason)
+                if await fo.should_retry(reason):
+                    continue
+            else:
+                # a 4xx the endpoint rejected is not endpoint sickness; it
+                # must not feed the breaker (or burn failover attempts) —
+                # but it IS liveness evidence, which resolves a half-open
+                # probe instead of leaking its slot
+                lease.fail()
+                fo.record_alive(endpoint)
+            _record(state, endpoint=endpoint, model=canonical,
+                    api_kind=api_kind, path=path, status=502, started=started,
+                    client_ip=client_ip, auth=auth,
+                    error=f"upstream HTTP {status_code}: {detail}",
+                    request_body=stored_body)
+            return error_response(
+                502, f"upstream returned {status_code}: {detail}",
+                "server_error",
+            )
+
+        content_type = upstream.headers.get("Content-Type", "")
+        if is_stream and "text/event-stream" in content_type:
+            result = await _forward_stream(
+                request, state, upstream, endpoint, canonical, api_kind, path,
+                started, lease, prompt_text, client_ip, auth, stored_body,
+                trace=trace, failover=fo,
+            )
+            if isinstance(result, PreStreamFailure):
+                fo.record_failure(endpoint, lease, "stream_pre_byte")
+                if trace is not None:
+                    trace.end("proxy")
+                if await fo.should_retry("stream_pre_byte"):
+                    continue
+                _record(state, endpoint=endpoint, model=canonical,
+                        api_kind=api_kind, path=path, status=502,
+                        started=started, client_ip=client_ip, auth=auth,
+                        error=result.error, stream=True,
+                        request_body=stored_body)
+                return error_response(
+                    502,
+                    f"upstream stream failed before first byte: "
+                    f"{result.error}",
+                    "server_error",
+                )
+            return result
+
+        observe_first_token(state, trace, canonical, endpoint.name, started)
+        try:
+            raw = await upstream.read()
+        except RETRYABLE_EXCEPTIONS as e:
+            # endpoint died mid-body: nothing reached the client, so this
+            # fails over like a connect failure (and must book an outcome,
+            # or a half-open probe slot would wedge)
+            upstream.release()
+            fo.record_failure(endpoint, lease, "read_error")
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry("read_error"):
+                continue
+            _record(state, endpoint=endpoint, model=canonical,
+                    api_kind=api_kind, path=path, status=502, started=started,
+                    client_ip=client_ip, auth=auth,
+                    error=f"response read failed: {type(e).__name__}: {e}",
+                    request_body=stored_body)
+            return error_response(
+                502, f"upstream response read failed: {type(e).__name__}",
+                "server_error",
+            )
+        upstream.release()
+        if trace is not None:
+            trace.end("proxy")
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            parsed = None
+        usage = (extract_usage_from_response(parsed)
+                 if isinstance(parsed, dict) else None)
+        if usage is None:
+            completion_text = _extract_completion_text(parsed) if parsed else ""
+            usage = (estimate_tokens(prompt_text),
+                     estimate_tokens(completion_text))
+        lease.complete_with_tokens(*usage)
+        fo.record_success(endpoint)
+        _record(state, endpoint=endpoint, model=canonical, api_kind=api_kind,
+                path=path, status=200, started=started,
+                prompt_tokens=usage[0], completion_tokens=usage[1],
+                client_ip=client_ip, auth=auth, request_body=stored_body)
+        state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
+        return web.Response(
+            body=raw, status=200,
+            content_type="application/json",
+        )
+
+
+def sse_error_frame(message: str, code: str = "stream_interrupted") -> bytes:
+    """Final SSE `event: error` frame written before closing a cut stream,
+    so clients can distinguish an interrupted stream from a completed one
+    (a bare close is indistinguishable from normal EOF to most SSE
+    consumers). Leads with a blank line: the passthrough is byte-for-byte,
+    so the cut may land mid-line — the terminator ends any dangling partial
+    event, otherwise `event: error` would be absorbed into it."""
+    payload = {"error": {"message": message, "type": "server_error",
+                         "code": code}}
+    return (
+        f"\n\nevent: error\ndata: "
+        f"{json.dumps(payload, separators=(',', ':'))}\n\n"
+    ).encode()
 
 
 async def _forward_stream(
     request, state: AppState, upstream, endpoint, model, api_kind, path,
     started, lease, prompt_text, client_ip, auth, stored_body=None,
-    trace=None,
-) -> web.StreamResponse:
-    """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120)."""
+    trace=None, failover: FailoverController | None = None,
+) -> "web.StreamResponse | PreStreamFailure":
+    """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120).
+
+    The first upstream chunk is pulled BEFORE the client response is
+    prepared: a failure there returns PreStreamFailure (retryable by the
+    caller, nothing was sent). After the first byte the stream is committed —
+    an upstream cut emits a final `event: error` frame, counts against the
+    endpoint (breaker + balancer per-endpoint stats), and records 502; a
+    client disconnect counts against nobody."""
+    iterator = upstream.content.iter_any()
+    first_chunk: bytes | None = None
+    try:
+        first_chunk = await iterator.__anext__()
+    except StopAsyncIteration:
+        first_chunk = None  # empty-but-clean stream: forward the EOF as-is
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            ConnectionResetError) as e:
+        upstream.release()
+        return PreStreamFailure(f"{type(e).__name__}: {e}")
+
     headers = {
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -364,23 +506,46 @@ async def _forward_stream(
     acc = StreamingTokenAccumulator()
     status = 200
     error = None
-    first_chunk = True
+    upstream_failed = False
     try:
-        async for chunk in upstream.content.iter_any():
-            if first_chunk:
-                first_chunk = False
-                observe_first_token(state, trace, model, endpoint.name,
-                                    started, streaming=True)
-            acc.feed(chunk)
-            await resp.write(chunk)
+        if first_chunk is not None:
+            observe_first_token(state, trace, model, endpoint.name,
+                                started, streaming=True)
+            acc.feed(first_chunk)
+            await resp.write(first_chunk)
+            while True:
+                try:
+                    chunk = await iterator.__anext__()
+                except StopAsyncIteration:
+                    break
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    # mid-stream upstream cut: tell the client, then count
+                    # it against the endpoint
+                    status = 502
+                    error = f"stream interrupted: {type(e).__name__}"
+                    upstream_failed = True
+                    await resp.write(sse_error_frame(error))
+                    break
+                acc.feed(chunk)
+                await resp.write(chunk)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
             ConnectionResetError) as e:
-        status, error = 502, f"stream interrupted: {type(e).__name__}"
+        # resp.write failed: the CLIENT went away — not endpoint sickness,
+        # so neither breaker nor per-endpoint failure stats move.
+        status = 502
+        error = error or f"client disconnected: {type(e).__name__}"
     finally:
         upstream.release()
         if trace is not None:
             trace.end("decode")
             trace.end("proxy")
+        # lease already completed at stream start; this books the breaker +
+        # balancer stats + interruption metric (and resolves a half-open
+        # probe even when the CLIENT was the one that went away)
+        book_stream_outcome(state, failover, endpoint, model,
+                            upstream_failed=upstream_failed,
+                            completed=status == 200)
         pt, ct, reported = acc.finalize(prompt_text)
         duration_s = time.monotonic() - started
         if ct > 0:

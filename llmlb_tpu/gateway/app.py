@@ -44,6 +44,7 @@ PUBLIC_PATHS = {
     ("POST", "/api/auth/login"),
     ("POST", "/api/auth/register"),
     ("GET", "/health"),
+    ("GET", "/api/health"),  # fleet health + breaker state, same stance
     ("GET", "/metrics"),  # Prometheus scrape, same stance as the engine's
     ("GET", "/"),
 }
@@ -494,6 +495,7 @@ def create_app(state: AppState) -> web.Application:
 
     # ---- liveness + root
     r.add_get("/health", _health)
+    r.add_get("/api/health", _api_health)
     r.add_get("/", _root)
 
     # ---- dashboard SPA (static bundle, embedded in the reference binary)
@@ -512,6 +514,60 @@ def create_app(state: AppState) -> web.Application:
 
 async def _health(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok"})
+
+
+async def _api_health(request: web.Request) -> web.Response:
+    """GET /api/health — fleet-level health: per-endpoint status as the
+    scheduler sees it right now (pull-checker status AND in-band breaker
+    state + outcome counters), admission pressure, and the retry budget.
+    The gateway-side counterpart of the engine's /api/health.
+
+    Public (same stance as /metrics, which already exposes endpoint names
+    and breaker states as labels) — but only names, never endpoint ids:
+    ids are admin-API identifiers and stay behind auth."""
+    state: AppState = request.app["state"]
+    endpoints = []
+    for ep in state.registry.list_all():
+        breaker = (state.resilience.breaker_info(ep.id)
+                   if state.resilience is not None
+                   else {"state": ep.breaker_state})
+        endpoints.append({
+            "name": ep.name,
+            "status": ep.status.value,
+            "breaker": breaker,
+            "latency_ms": ep.latency_ms,
+            "consecutive_probe_failures": ep.consecutive_failures,
+            "outcomes": state.load_manager.endpoint_outcomes(ep.id),
+            "active_requests": state.load_manager.active_count(ep.id),
+        })
+    online = sum(1 for e in endpoints if e["status"] == "online")
+    serving = sum(
+        1 for e in endpoints
+        if e["status"] == "online" and e["breaker"]["state"] != "open"
+    )
+    body = {
+        "status": "ok" if serving or not endpoints else "degraded",
+        "uptime_s": round(time.time() - state.started_at, 1),
+        "endpoints_online": online,
+        "endpoints_serving": serving,  # online AND breaker not open
+        "endpoints": endpoints,
+        "admission": {
+            "queue_depth": state.admission.queue_depth(),
+            "active_requests": state.load_manager.total_active(),
+        },
+    }
+    if state.resilience is not None:
+        cfg = state.resilience.config
+        body["resilience"] = {
+            "enabled": cfg.enabled,
+            "max_attempts": cfg.max_attempts,
+            "breaker_failure_threshold": cfg.breaker_failure_threshold,
+            "breaker_open_s": cfg.breaker_open_s,
+            "retry_budget": state.resilience.budget.snapshot(),
+        }
+    if state.faults is not None:
+        body["faults"] = state.faults.snapshot()
+    return web.json_response(body)
 
 
 async def _gateway_metrics(request: web.Request) -> web.Response:

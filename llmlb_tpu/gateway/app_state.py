@@ -25,13 +25,20 @@ from llmlb_tpu.gateway.auth import (
     ensure_admin_exists,
 )
 from llmlb_tpu.gateway.balancer import AdmissionQueue, LoadManager
-from llmlb_tpu.gateway.config import QueueConfig, ServerConfig, env_int
+from llmlb_tpu.gateway.config import (
+    QueueConfig,
+    ResilienceConfig,
+    ServerConfig,
+    env_int,
+)
 from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
+from llmlb_tpu.gateway.faults import FaultInjector
 from llmlb_tpu.gateway.gate import InferenceGate
 from llmlb_tpu.gateway.health import EndpointHealthChecker
 from llmlb_tpu.gateway.metrics import GatewayMetrics
 from llmlb_tpu.gateway.registry import EndpointRegistry
+from llmlb_tpu.gateway.resilience import ResilienceManager
 from llmlb_tpu.gateway.tracing import TraceStore
 from llmlb_tpu.gateway.types import TpsApiKind
 
@@ -55,6 +62,8 @@ class AppState:
     http: aiohttp.ClientSession
     metrics: GatewayMetrics
     traces: TraceStore
+    resilience: ResilienceManager | None = None
+    faults: FaultInjector | None = None
     health_checker: EndpointHealthChecker | None = None
     update_manager: object | None = None  # set by gateway.update
     tray: object | None = None  # TrayController when LLMLB_TRAY=1
@@ -127,11 +136,22 @@ async def build_app_state(
         connector=aiohttp.TCPConnector(limit_per_host=32, keepalive_timeout=60)
     )
 
+    # Resilience layer: per-endpoint circuit breakers + the global retry
+    # budget; selection consults it through load_manager.resilience. The
+    # fault injector is None unless LLMLB_FAULTS configures rules (or a
+    # chaos test installs them) — zero hot-path cost otherwise.
+    resilience = ResilienceManager(
+        ResilienceConfig.from_env(), metrics=metrics, events=events,
+        registry=registry,
+    )
+    load_manager.resilience = resilience
+    faults = FaultInjector.from_env()
+
     state = AppState(
         config=config, db=db, registry=registry, load_manager=load_manager,
         admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
-        metrics=metrics, traces=traces,
+        metrics=metrics, traces=traces, resilience=resilience, faults=faults,
     )
 
     _seed_tps_from_daily_stats(state)
@@ -142,6 +162,7 @@ async def build_app_state(
             registry, load_manager, db, http, events,
             interval_s=config.health_check_interval_s,
             timeout_s=config.health_check_timeout_s,
+            resilience=resilience,
         )
         checker.start()
         state.health_checker = checker

@@ -194,6 +194,16 @@ class LoadManager:
         self._affinity_hits = 0
         self._affinity_misses = 0
         self._affinity_evictions = 0
+        # In-band per-endpoint outcome stats (resilience layer feeds these;
+        # stream interruptions land here too — before this, a stream that
+        # died mid-flight never counted against its endpoint because the
+        # lease completes at stream start). Independent of the breaker:
+        # surfaced in /api/health and stats() even with resilience disabled.
+        self._endpoint_outcomes: dict[str, dict] = {}
+        # ResilienceManager | None (set by app_state): selection consults
+        # allow() so breaker-open endpoints are ejected immediately, and
+        # reports admissions via on_admit() (half-open probe accounting).
+        self.resilience = None
         # Called (outside the lock) with the endpoint id each time a lease is
         # released — the AdmissionQueue uses it to wake parked waiters instead
         # of having them poll (parity: balancer/mod.rs:2273-2427 notify path).
@@ -332,7 +342,62 @@ class LoadManager:
                 "evictions_total": self._affinity_evictions,
             }
 
+    # ------------------------------------------------------ endpoint outcomes
+
+    def _outcomes_for(self, endpoint_id: str) -> dict:
+        """Caller holds self._lock."""
+        return self._endpoint_outcomes.setdefault(endpoint_id, {
+            "successes": 0, "failures": 0, "stream_interruptions": 0,
+            "consecutive_failures": 0, "last_failure_ts": None,
+        })
+
+    def note_endpoint_success(self, endpoint_id: str) -> None:
+        with self._lock:
+            o = self._outcomes_for(endpoint_id)
+            o["successes"] += 1
+            o["consecutive_failures"] = 0
+
+    def note_endpoint_failure(self, endpoint_id: str, *,
+                              stream_interruption: bool = False) -> None:
+        with self._lock:
+            o = self._outcomes_for(endpoint_id)
+            o["failures"] += 1
+            if stream_interruption:
+                o["stream_interruptions"] += 1
+            o["consecutive_failures"] += 1
+            o["last_failure_ts"] = time.time()
+
+    def endpoint_outcomes(self, endpoint_id: str | None = None) -> dict:
+        """In-band outcome counters, per endpoint or the whole map. Pure
+        read: never inserts (scrape paths must not grow the map)."""
+        with self._lock:
+            if endpoint_id is not None:
+                o = self._endpoint_outcomes.get(endpoint_id)
+                return dict(o) if o is not None else {
+                    "successes": 0, "failures": 0, "stream_interruptions": 0,
+                    "consecutive_failures": 0, "last_failure_ts": None,
+                }
+            return {eid: dict(o) for eid, o in self._endpoint_outcomes.items()}
+
+    def drop_endpoint_outcomes(self, endpoint_id: str) -> None:
+        """Endpoint deleted: stop carrying its counters (ids churn on
+        re-registration; dead entries would inflate stats() forever)."""
+        with self._lock:
+            self._endpoint_outcomes.pop(endpoint_id, None)
+
     # -------------------------------------------------------------- selection
+
+    def _permitted(self, endpoints: list[Endpoint]) -> list[Endpoint]:
+        """Drop endpoints whose circuit breaker refuses traffic right now.
+        No resilience manager wired (unit tests, resilience disabled) means
+        no filtering."""
+        if self.resilience is None:
+            return endpoints
+        return [ep for ep in endpoints if self.resilience.allow(ep.id)]
+
+    def _note_admitted(self, endpoint_id: str) -> None:
+        if self.resilience is not None:
+            self.resilience.on_admit(endpoint_id)
 
     def select_endpoint(
         self,
@@ -345,7 +410,8 @@ class LoadManager:
         last served this prompt head, while it is a live candidate under its
         cap), then telemetry-weighted measured-TPS desc; unmeasured first
         (probe), telemetry then round-robin among equals; full endpoints
-        (admission cap) excluded."""
+        (admission cap) excluded; breaker-open endpoints ejected."""
+        endpoints = self._permitted(endpoints)
         if not endpoints:
             return None
         if self._rc is not None:
@@ -440,6 +506,7 @@ class LoadManager:
         """Atomic select + lease under one lock: concurrent admissions cannot
         both pick the last free slot of an endpoint (the select-then-begin
         two-step had that race)."""
+        endpoints = self._permitted(endpoints)
         if not endpoints:
             return None
         if self._rc is not None:
@@ -456,6 +523,7 @@ class LoadManager:
                 if got == 0:
                     self._affinity_record(model, prefix_hash, sticky.id,
                                           hit=True)
+                    self._note_admitted(sticky.id)
                     return sticky, RequestLease(self, sticky.id, model,
                                                 api_kind)
             idx = self._rc_select(endpoints, model, api_kind, admit=True)
@@ -463,6 +531,7 @@ class LoadManager:
                 return None
             chosen = endpoints[idx]
             self._affinity_record(model, prefix_hash, chosen.id, hit=False)
+            self._note_admitted(chosen.id)
             return chosen, RequestLease(self, chosen.id, model, api_kind)
         with self._lock:
             chosen = self._select_locked(endpoints, model, api_kind,
@@ -471,11 +540,16 @@ class LoadManager:
                 return None
             self._active[chosen.id] += 1
             self._total_requests += 1
+        self._note_admitted(chosen.id)
         return chosen, RequestLease(self, chosen.id, model, api_kind)
 
     def begin_request(
         self, endpoint: Endpoint, model: str, api_kind: TpsApiKind
     ) -> RequestLease:
+        # No _note_admitted here: begin_request callers (playground proxy)
+        # target one explicit endpoint, bypass breaker-filtered selection,
+        # and never report outcomes — consuming a half-open probe slot from
+        # this path would wedge the breaker with no outcome to resolve it.
         if self._rc is not None:
             self._rc.begin(endpoint.id)
             return RequestLease(self, endpoint.id, model, api_kind)
@@ -538,6 +612,16 @@ class LoadManager:
             return [buckets[k] for k in sorted(buckets)]
 
     def stats(self) -> dict:
+        with self._lock:
+            outcome_totals = {
+                "endpoint_failures_total": sum(
+                    o["failures"] for o in self._endpoint_outcomes.values()
+                ),
+                "stream_interruptions_total": sum(
+                    o["stream_interruptions"]
+                    for o in self._endpoint_outcomes.values()
+                ),
+            }
         if self._rc is not None:
             with self._lock:
                 history_size = len(self._history)
@@ -547,6 +631,7 @@ class LoadManager:
                 "history_size": history_size,
                 "tracked_tps_keys": self._rc.tracked_keys(),
                 "native_router": True,
+                **outcome_totals,
             }
         with self._lock:
             return {
@@ -554,6 +639,7 @@ class LoadManager:
                 "active_requests": sum(self._active.values()),
                 "history_size": len(self._history),
                 "tracked_tps_keys": len(self._tps),
+                **outcome_totals,
             }
 
 

@@ -8,6 +8,7 @@ over. No config files; runtime-mutable settings live in the DB settings table.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 
 
@@ -56,6 +57,76 @@ class QueueConfig:
             max_queue_size=env_int("LLMLB_QUEUE_MAX_SIZE", 100),
             queue_timeout_s=env_float("LLMLB_QUEUE_TIMEOUT_SECS", 30.0),
             max_active_per_endpoint=env_int("LLMLB_MAX_ACTIVE_PER_ENDPOINT", 32),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """In-band failover + per-endpoint circuit breaking (gateway/resilience.py).
+
+    Retries: a failed upstream attempt (connect error, timeout, retryable
+    status) re-runs endpoint selection excluding the failed endpoint, with
+    capped exponential backoff + jitter, under a global retry budget —
+    retries are capped as a fraction of recent request volume so a melting
+    fleet is not amplified by its own failover traffic.
+
+    Breaker: consecutive in-band failures trip an endpoint open (ejected
+    from selection immediately, no 30 s health-probe wait); after the open
+    interval one half-open probe request is admitted, and its outcome
+    closes or re-opens (with doubled interval, capped) the breaker.
+    """
+
+    enabled: bool = True
+    max_attempts: int = 3  # total tries per request, incl. the first
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    # Re-selection after a failure parks at most this long for a slot —
+    # a failed request must not burn the full client queue timeout again.
+    failover_queue_timeout_s: float = 5.0
+    retry_budget_ratio: float = 0.2  # retries per recent request
+    retry_budget_min: int = 10  # floor: always allow this many per window
+    retry_budget_window_s: float = 60.0
+    retryable_statuses: tuple[int, ...] = (429, 500, 502, 503, 504)
+    breaker_failure_threshold: int = 5  # consecutive failures to trip
+    breaker_open_s: float = 10.0
+    breaker_open_max_s: float = 120.0  # repeated trips double up to this
+    breaker_half_open_probes: int = 1
+
+    @classmethod
+    def from_env(cls) -> "ResilienceConfig":
+        raw_statuses = env_str("LLMLB_RETRY_STATUSES", "")
+        statuses = cls.retryable_statuses
+        if raw_statuses:
+            try:
+                statuses = tuple(
+                    int(s) for s in raw_statuses.split(",") if s.strip()
+                )
+            except ValueError:
+                logging.getLogger("llmlb_tpu.gateway.config").warning(
+                    "LLMLB_RETRY_STATUSES=%r is not a comma-separated list "
+                    "of integers; using default %r",
+                    raw_statuses, statuses,
+                )
+        return cls(
+            enabled=env_bool("LLMLB_RESILIENCE", True),
+            max_attempts=max(1, env_int("LLMLB_RETRY_MAX_ATTEMPTS", 3)),
+            backoff_base_s=env_float("LLMLB_RETRY_BACKOFF_BASE", 0.05),
+            backoff_cap_s=env_float("LLMLB_RETRY_BACKOFF_CAP", 2.0),
+            failover_queue_timeout_s=env_float(
+                "LLMLB_FAILOVER_QUEUE_TIMEOUT", 5.0
+            ),
+            retry_budget_ratio=env_float("LLMLB_RETRY_BUDGET_RATIO", 0.2),
+            retry_budget_min=env_int("LLMLB_RETRY_BUDGET_MIN", 10),
+            retry_budget_window_s=env_float("LLMLB_RETRY_BUDGET_WINDOW", 60.0),
+            retryable_statuses=statuses,
+            breaker_failure_threshold=max(
+                1, env_int("LLMLB_BREAKER_FAILURE_THRESHOLD", 5)
+            ),
+            breaker_open_s=env_float("LLMLB_BREAKER_OPEN_SECS", 10.0),
+            breaker_open_max_s=env_float("LLMLB_BREAKER_OPEN_MAX_SECS", 120.0),
+            breaker_half_open_probes=max(
+                1, env_int("LLMLB_BREAKER_HALF_OPEN_PROBES", 1)
+            ),
         )
 
 

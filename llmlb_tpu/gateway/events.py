@@ -19,6 +19,7 @@ class DashboardEventBus:
         "EndpointRegistered",
         "EndpointStatusChanged",
         "EndpointRemoved",
+        "BreakerStateChanged",
         "MetricsUpdated",
         "TpsUpdated",
         "UpdateStateChanged",

@@ -76,6 +76,7 @@ class EndpointHealthChecker:
         events: DashboardEventBus | None = None,
         interval_s: float = 30.0,
         timeout_s: float = 5.0,
+        resilience=None,
     ):
         self.registry = registry
         self.load_manager = load_manager
@@ -84,6 +85,10 @@ class EndpointHealthChecker:
         self.events = events
         self.interval_s = interval_s
         self.timeout_s = timeout_s
+        # ResilienceManager | None: in-band breaker state reconciles with
+        # this pull checker — a good probe fast-forwards an open breaker to
+        # half-open, a recovered-from-offline endpoint gets a fresh breaker.
+        self.resilience = resilience
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -180,6 +185,14 @@ class EndpointHealthChecker:
                 accelerator=result.accelerator,
                 consecutive_failures=0,
             )
+            if self.resilience is not None:
+                if recovered:
+                    # the engine restarted; in-band failure history is stale
+                    self.resilience.reset(ep.id)
+                else:
+                    # good probe: open breaker fast-forwards to half-open so
+                    # the next real request (not the 30 s timer) decides
+                    self.resilience.note_probe(ep.id, True)
             if recovered:
                 await self._on_recovery(ep)
         else:
@@ -193,6 +206,8 @@ class EndpointHealthChecker:
             self.registry.update_status(
                 ep.id, new_status, consecutive_failures=failures
             )
+            if self.resilience is not None:
+                self.resilience.note_probe(ep.id, False)
             if new_status == EndpointStatus.OFFLINE:
                 # recovered endpoints must re-measure TPS (:313-317)
                 self.load_manager.clear_tps_for_endpoint(ep.id)

@@ -16,6 +16,14 @@ Series:
   llmlb_gateway_ttft_seconds{model,endpoint}   histogram
   llmlb_gateway_e2e_seconds{model,endpoint}    histogram
   llmlb_gateway_queue_wait_seconds{model,endpoint} histogram
+resilience-layer series (gateway/resilience.py):
+  llmlb_gateway_failover_retries_total{model,reason}     counter
+  llmlb_gateway_failover_recoveries_total{model}         counter
+  llmlb_gateway_retry_budget_exhausted_total             counter
+  llmlb_gateway_breaker_transitions_total{endpoint,to}   counter
+  llmlb_gateway_breaker_state{endpoint}                  gauge (0/1/2)
+  llmlb_gateway_stream_interruptions_total{model,endpoint} counter
+  llmlb_gateway_faults_injected_total{kind}              counter
 plus scrape-time gauges (active requests, admission queue depth, event-bus
 drops, trace-buffer size) injected by the /metrics handler.
 """
@@ -54,6 +62,14 @@ class GatewayMetrics:
         self._ttft: dict[tuple[str, str], Histogram] = {}
         self._e2e: dict[tuple[str, str], Histogram] = {}
         self._queue_wait: dict[tuple[str, str], Histogram] = {}
+        # resilience layer (gateway/resilience.py)
+        self._failover_retries: dict[tuple[str, str], int] = defaultdict(int)
+        self._failover_recoveries: dict[str, int] = defaultdict(int)
+        self._retry_budget_exhausted = 0
+        self._breaker_transitions: dict[tuple[str, str], int] = defaultdict(int)
+        self._breaker_state: dict[str, int] = {}
+        self._stream_interruptions: dict[tuple[str, str], int] = defaultdict(int)
+        self._faults_injected: dict[str, int] = defaultdict(int)
 
     # ------------------------------------------------------------ recorders
 
@@ -73,6 +89,49 @@ class GatewayMetrics:
     def record_queue_timeout(self, model: str) -> None:
         with self._lock:
             self._queue_timeouts[model] += 1
+
+    # --------------------------------------------------- resilience recorders
+
+    def record_failover_retry(self, model: str, reason: str) -> None:
+        """One in-band failover retry: the request is being re-run against a
+        different endpoint after `reason` (connect_error/timeout/http_5xx/
+        http_429/stream_pre_byte)."""
+        with self._lock:
+            self._failover_retries[(model, reason)] += 1
+
+    def record_failover_recovery(self, model: str) -> None:
+        """A request that failed on >= 1 endpoint ultimately succeeded —
+        the failure the client never saw."""
+        with self._lock:
+            self._failover_recoveries[model] += 1
+
+    def record_retry_budget_exhausted(self) -> None:
+        with self._lock:
+            self._retry_budget_exhausted += 1
+
+    def record_breaker_transition(self, endpoint: str, to_state: str) -> None:
+        with self._lock:
+            self._breaker_transitions[(endpoint, to_state)] += 1
+
+    def set_breaker_state(self, endpoint: str, code: int) -> None:
+        """Current breaker state per endpoint: 0=closed, 1=half_open, 2=open."""
+        with self._lock:
+            self._breaker_state[endpoint] = code
+
+    def clear_breaker_state(self, endpoint: str) -> None:
+        """Endpoint deleted: stop exporting its state gauge (a frozen open
+        reading would alert on a nonexistent endpoint forever). Transition
+        counters stay — they are history, not state."""
+        with self._lock:
+            self._breaker_state.pop(endpoint, None)
+
+    def record_stream_interruption(self, model: str, endpoint: str) -> None:
+        with self._lock:
+            self._stream_interruptions[(model, endpoint)] += 1
+
+    def record_fault_injected(self, kind: str) -> None:
+        with self._lock:
+            self._faults_injected[kind] += 1
 
     def _observe(self, table: dict, buckets: tuple[float, ...],
                  model: str, endpoint: str, seconds: float) -> None:
@@ -118,6 +177,12 @@ class GatewayMetrics:
                 "errors_total": sum(self._errors.values()),
                 "retries_total": sum(self._retries.values()),
                 "queue_timeouts_total": sum(self._queue_timeouts.values()),
+                "failover_retries_total": sum(self._failover_retries.values()),
+                "failover_recoveries_total":
+                    sum(self._failover_recoveries.values()),
+                "stream_interruptions_total":
+                    sum(self._stream_interruptions.values()),
+                "faults_injected_total": sum(self._faults_injected.values()),
                 "ttft_s": pcts(self._ttft),
                 "e2e_s": pcts(self._e2e),
                 "queue_wait_s": pcts(self._queue_wait),
@@ -149,6 +214,62 @@ class GatewayMetrics:
                 lines.append(
                     f'llmlb_gateway_queue_timeouts_total'
                     f'{{model="{_escape(model)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_failover_retries_total counter"
+            )
+            for (model, reason), n in sorted(self._failover_retries.items()):
+                lines.append(
+                    f'llmlb_gateway_failover_retries_total'
+                    f'{{model="{_escape(model)}",reason="{_escape(reason)}"}}'
+                    f' {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_failover_recoveries_total counter"
+            )
+            for model, n in sorted(self._failover_recoveries.items()):
+                lines.append(
+                    f'llmlb_gateway_failover_recoveries_total'
+                    f'{{model="{_escape(model)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_retry_budget_exhausted_total counter"
+            )
+            lines.append(
+                f"llmlb_gateway_retry_budget_exhausted_total "
+                f"{self._retry_budget_exhausted}"
+            )
+            lines.append(
+                "# TYPE llmlb_gateway_breaker_transitions_total counter"
+            )
+            for (endpoint, to), n in sorted(self._breaker_transitions.items()):
+                lines.append(
+                    f'llmlb_gateway_breaker_transitions_total'
+                    f'{{endpoint="{_escape(endpoint)}",to="{_escape(to)}"}}'
+                    f' {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_breaker_state gauge")
+            for endpoint, code in sorted(self._breaker_state.items()):
+                lines.append(
+                    f'llmlb_gateway_breaker_state'
+                    f'{{endpoint="{_escape(endpoint)}"}} {code}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_stream_interruptions_total counter"
+            )
+            for (model, endpoint), n in sorted(
+                self._stream_interruptions.items()
+            ):
+                lines.append(
+                    f'llmlb_gateway_stream_interruptions_total'
+                    f'{{model="{_escape(model)}",'
+                    f'endpoint="{_escape(endpoint)}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_faults_injected_total counter")
+            for kind, n in sorted(self._faults_injected.items()):
+                lines.append(
+                    f'llmlb_gateway_faults_injected_total'
+                    f'{{kind="{_escape(kind)}"}} {n}'
                 )
             for name, table in (
                 ("llmlb_gateway_ttft_seconds", self._ttft),

@@ -103,6 +103,16 @@ class EndpointRegistry:
             self.db.upsert_endpoint(ep)
             return ep
 
+    def set_breaker_state(self, endpoint_id: str, state: str) -> None:
+        """Mirror the in-band circuit breaker's state onto the cached
+        endpoint (resilience.py calls this on every transition). Cache-only
+        on purpose: breaker state is runtime truth, not configuration, so it
+        must not round-trip through the DB."""
+        with self._lock:
+            ep = self._endpoints.get(endpoint_id)
+            if ep is not None:
+                ep.breaker_state = state
+
     def update_type(self, endpoint_id: str, endpoint_type: EndpointType) -> None:
         with self._lock:
             ep = self._endpoints.get(endpoint_id)

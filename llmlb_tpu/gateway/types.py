@@ -106,6 +106,10 @@ class Endpoint:
     status: EndpointStatus = EndpointStatus.PENDING
     latency_ms: float | None = None
     consecutive_failures: int = 0
+    # In-band circuit-breaker state (gateway/resilience.py), mirrored here by
+    # the registry so every endpoint listing carries it. Transient: not
+    # persisted — a restarted gateway starts with closed breakers.
+    breaker_state: str = "closed"
     accelerator: AcceleratorInfo = dataclasses.field(default_factory=AcceleratorInfo)
     created_at: float = dataclasses.field(default_factory=time.time)
     updated_at: float = dataclasses.field(default_factory=time.time)

@@ -415,24 +415,176 @@ async def run_mixed_length_bench(requests_n: int) -> dict:
     }
 
 
+async def run_chaos_bench(seconds: float, concurrency: int) -> dict:
+    """Chaos drill: the real gateway + two stub endpoints serving one model,
+    with one endpoint flapping hard (connect-refused injected at the proxy's
+    HTTP boundary, ~50% duty cycle) for the whole run. Mixed non-streamed +
+    streamed clients hammer /v1/chat/completions; the resilience layer
+    (failover + breaker, docs/resilience.md) must keep the client-visible
+    success rate >= 99%. Exit code 1 if it doesn't."""
+    from llmlb_tpu.gateway.config import ResilienceConfig
+    from llmlb_tpu.gateway.faults import FaultInjector, FaultRule
+    from llmlb_tpu.gateway.resilience import ResilienceManager
+    from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+    gw = await GatewayHarness.create()
+    stable = await MockOpenAIEndpoint(model="chaos-model").start()
+    flappy = await MockOpenAIEndpoint(model="chaos-model").start()
+    try:
+        gw.register_mock(stable.url, ["chaos-model"], name="stable")
+        ep_flappy = gw.register_mock(flappy.url, ["chaos-model"],
+                                     name="flappy")
+        # Bench-tuned knobs: fast breaker cycles so several trip/half-open/
+        # close rounds happen within a short run; tiny backoff so retries
+        # don't dominate the latency figures.
+        manager = ResilienceManager(
+            ResilienceConfig(
+                breaker_failure_threshold=3, breaker_open_s=0.5,
+                breaker_open_max_s=2.0, backoff_base_s=0.005,
+                backoff_cap_s=0.05, failover_queue_timeout_s=1.0,
+            ),
+            metrics=gw.state.metrics, events=gw.state.events,
+            registry=gw.state.registry,
+        )
+        gw.state.resilience = manager
+        gw.state.load_manager.resilience = manager
+        faults = FaultInjector()
+        gw.state.faults = faults
+
+        headers = dict(await gw.inference_headers())
+
+        ok = 0
+        failed = 0
+        stream_errors = 0
+        statuses: dict[int, int] = {}
+        deadline = time.perf_counter() + seconds
+        running = True
+
+        async def flapper() -> None:
+            # ~50% duty cycle: dead 0.7 s, alive 0.7 s, forever
+            while running:
+                rule = faults.add_rule(FaultRule(
+                    kind="connect_refused", endpoint="flappy", every_n=1,
+                ))
+                await asyncio.sleep(0.7)
+                faults.remove_rule(rule)
+                await asyncio.sleep(0.7)
+
+        async def worker(i: int) -> None:
+            nonlocal ok, failed, stream_errors
+            n = 0
+            while time.perf_counter() < deadline:
+                n += 1
+                stream = (i + n) % 4 == 0  # 1 in 4 requests streamed
+                payload = {
+                    "model": "chaos-model",
+                    "messages": [{"role": "user", "content": f"ping {n}"}],
+                    "stream": stream,
+                }
+                try:
+                    resp = await gw.client.post(
+                        "/v1/chat/completions", json=payload, headers=headers
+                    )
+                    body = await resp.read()
+                    statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                    if resp.status == 200 and (
+                        not stream or b"event: error" not in body
+                    ):
+                        ok += 1
+                    else:
+                        failed += 1
+                        if resp.status == 200:
+                            stream_errors += 1
+                except Exception:
+                    failed += 1
+
+        flap_task = asyncio.create_task(flapper())
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(i) for i in range(concurrency)))
+        elapsed = time.perf_counter() - t0
+        running = False
+        flap_task.cancel()
+        try:
+            await flap_task
+        except asyncio.CancelledError:
+            pass
+
+        # one source of truth: the same figures must appear in /metrics
+        resp = await gw.client.get("/metrics")
+        exposition = await resp.text()
+
+        def series_sum(name: str) -> float:
+            total = 0.0
+            for line in exposition.splitlines():
+                if line.startswith(name) and not line.startswith("# "):
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        total = ok + failed
+        success_rate = ok / max(1, total)
+        result = {
+            "metric": "chaos_client_success_rate",
+            "value": round(success_rate, 5),
+            "unit": "fraction",
+            "passed": success_rate >= 0.99,
+            "requests": total,
+            "ok": ok,
+            "failed": failed,
+            "stream_error_frames": stream_errors,
+            "statuses": statuses,
+            "seconds": round(elapsed, 2),
+            "concurrency": concurrency,
+            "req_per_sec": round(total / elapsed, 1),
+            "stub_requests": {"stable": len(stable.requests_seen),
+                              "flappy": len(flappy.requests_seen)},
+            "flappy_breaker": manager.breaker_info(ep_flappy.id),
+            "prometheus": {
+                "failover_retries_total":
+                    series_sum("llmlb_gateway_failover_retries_total"),
+                "failover_recoveries_total":
+                    series_sum("llmlb_gateway_failover_recoveries_total"),
+                "breaker_transitions_total":
+                    series_sum("llmlb_gateway_breaker_transitions_total"),
+                "faults_injected_total":
+                    series_sum("llmlb_gateway_faults_injected_total"),
+                "retry_budget_exhausted_total":
+                    series_sum("llmlb_gateway_retry_budget_exhausted_total"),
+            },
+        }
+        return result
+    finally:
+        await stable.stop()
+        await flappy.stop()
+        await gw.close()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--concurrency", type=int, default=50)
     parser.add_argument(
-        "--workload", choices=("proxy", "shared-prefix", "mixed-length"),
+        "--workload",
+        choices=("proxy", "shared-prefix", "mixed-length", "chaos"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
                         help="request count for --workload shared-prefix / "
                              "mixed-length")
     args = parser.parse_args()
-    if args.workload != "proxy":
+    if args.workload not in ("proxy", "chaos"):
         _pin_platform()  # engine workloads touch jax: decide platform first
     if args.workload == "shared-prefix":
         result = asyncio.run(run_prefix_bench(args.requests))
     elif args.workload == "mixed-length":
         result = asyncio.run(run_mixed_length_bench(args.requests))
+    elif args.workload == "chaos":
+        result = asyncio.run(
+            run_chaos_bench(args.seconds, min(args.concurrency, 16))
+        )
+        print(json.dumps(result))
+        if not result["passed"]:
+            sys.exit(1)
+        return
     else:
         result = asyncio.run(run_bench(args.seconds, args.concurrency))
     print(json.dumps(result))

@@ -59,6 +59,14 @@ def gateway_metric_names() -> set[str]:
     g.record_ttft("m", "e", 0.1)
     g.record_e2e("m", "e", 0.1)
     g.record_queue_wait("m", "e", 0.1)
+    # resilience families (gateway/resilience.py)
+    g.record_failover_retry("m", "connect_error")
+    g.record_failover_recovery("m")
+    g.record_retry_budget_exhausted()
+    g.record_breaker_transition("e", "open")
+    g.set_breaker_state("e", 2)
+    g.record_stream_interruption("m", "e")
+    g.record_fault_injected("connect_refused")
     names = set(_TYPE_RE.findall(g.render()))
     # scrape-time gauges/counters injected by the /metrics handler
     app_src = (REPO / "llmlb_tpu" / "gateway" / "app.py").read_text()

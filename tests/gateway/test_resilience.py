@@ -1,0 +1,737 @@
+"""Resilience layer: failover retries, circuit breaker, fault injection.
+
+The chaos scenarios run entirely in-process: faults.py injects
+connect-refused / synthetic 5xx / mid-stream cuts at the proxy's HTTP
+boundary, so no real sockets die on cue and every test is deterministic.
+Tier-1 (fast, no TPU).
+"""
+
+import asyncio
+import json
+import time
+
+from llmlb_tpu.gateway.config import QueueConfig, ResilienceConfig
+from llmlb_tpu.gateway.faults import FaultInjector, FaultRule
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.resilience import (
+    BreakerState,
+    ResilienceManager,
+    RetryBudget,
+    backoff_delay,
+)
+from llmlb_tpu.gateway.types import EndpointStatus
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+CHAT = "/v1/chat/completions"
+
+
+def _chat_body(model="m", stream=False):
+    body = {"model": model,
+            "messages": [{"role": "user", "content": "ping"}]}
+    if stream:
+        body["stream"] = True
+    return body
+
+
+def _set_resilience(gw, **overrides) -> ResilienceManager:
+    """Swap in a ResilienceManager with test-tuned knobs (tiny backoff,
+    small thresholds) without touching process env."""
+    cfg = ResilienceConfig(**{
+        "backoff_base_s": 0.001, "backoff_cap_s": 0.002,
+        "failover_queue_timeout_s": 0.3, **overrides,
+    })
+    manager = ResilienceManager(
+        cfg, metrics=gw.state.metrics, events=gw.state.events,
+        registry=gw.state.registry,
+    )
+    gw.state.resilience = manager
+    gw.state.load_manager.resilience = manager
+    return manager
+
+
+# --------------------------------------------------------------- unit tests
+
+
+def test_breaker_trips_after_threshold_and_reopens():
+    m = ResilienceManager(ResilienceConfig(
+        breaker_failure_threshold=3, breaker_open_s=0.05,
+        breaker_open_max_s=0.5,
+    ))
+    eid = "ep1"
+    assert m.allow(eid)
+    for _ in range(2):
+        m.record_failure(eid)
+    assert m.state_of(eid) == BreakerState.CLOSED and m.allow(eid)
+    m.record_failure(eid)  # third strike trips
+    assert m.state_of(eid) == BreakerState.OPEN
+    assert not m.allow(eid)
+
+    time.sleep(0.06)
+    # open interval elapsed: lazily half-open, one probe admitted
+    assert m.allow(eid)
+    assert m.state_of(eid) == BreakerState.HALF_OPEN
+    m.on_admit(eid)
+    assert not m.allow(eid)  # probe slot consumed
+
+    m.record_failure(eid, "probe failed")  # probe fails: re-open, doubled
+    assert m.state_of(eid) == BreakerState.OPEN
+    info = m.breaker_info(eid)
+    assert 0.05 < info["retry_after_s"] <= 0.5
+
+    time.sleep(0.11)
+    assert m.allow(eid)
+    m.on_admit(eid)
+    m.record_success(eid)  # probe succeeds: closed, streak cleared
+    assert m.state_of(eid) == BreakerState.CLOSED
+    assert m.breaker_info(eid)["consecutive_failures"] == 0
+
+
+def test_breaker_success_resets_consecutive_failures():
+    m = ResilienceManager(ResilienceConfig(breaker_failure_threshold=3))
+    for _ in range(2):
+        m.record_failure("e")
+    m.record_success("e")
+    for _ in range(2):
+        m.record_failure("e")
+    assert m.state_of("e") == BreakerState.CLOSED  # never hit 3 consecutive
+
+
+def test_breaker_probe_reconcile_and_reset():
+    m = ResilienceManager(ResilienceConfig(
+        breaker_failure_threshold=1, breaker_open_s=60.0,
+    ))
+    m.record_failure("e")
+    assert m.state_of("e") == BreakerState.OPEN
+    # good pull-checker probe fast-forwards open -> half-open (no 60 s wait)
+    m.note_probe("e", True)
+    assert m.state_of("e") == BreakerState.HALF_OPEN
+    # bad probe while half-open re-opens
+    m.note_probe("e", False)
+    assert m.state_of("e") == BreakerState.OPEN
+    # offline->online recovery: fresh breaker
+    m.reset("e")
+    assert m.state_of("e") == BreakerState.CLOSED and m.allow("e")
+
+
+def test_retry_budget_ratio_and_floor():
+    b = RetryBudget(ratio=0.5, min_retries=2, window_s=60.0)
+    # floor: no traffic, still 2 retries allowed
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()
+    # ratio: 10 requests -> 5 allowed (floor already spent 2)
+    for _ in range(10):
+        b.note_request()
+    assert b.allowed() == 5
+    assert b.try_spend() and b.try_spend() and b.try_spend()
+    assert not b.try_spend()
+    snap = b.snapshot()
+    assert snap["requests_in_window"] == 10
+    assert snap["retries_in_window"] == 5
+
+
+def test_backoff_is_capped_with_jitter():
+    cfg = ResilienceConfig(backoff_base_s=0.1, backoff_cap_s=0.4)
+    for attempt, hi in ((1, 0.1), (2, 0.2), (3, 0.4), (7, 0.4)):
+        for _ in range(16):
+            d = backoff_delay(attempt, cfg)
+            assert hi / 2 <= d <= hi
+
+
+def test_fault_rule_every_n_is_deterministic():
+    class _Ep:
+        name, id, url = "ep-a", "id-a", "http://ep-a:1"
+
+    inj = FaultInjector()
+    rule = inj.add_rule(FaultRule(kind="http", endpoint="ep-a", every_n=3))
+    fired = [bool(inj.decide(_Ep(), CHAT)) for _ in range(9)]
+    assert fired == [False, False, True] * 3
+    assert rule.seen == 9 and rule.fires == 3
+    # other endpoints don't advance the counter
+    class _Other:
+        name, id, url = "ep-b", "id-b", "http://ep-b:1"
+
+    assert inj.decide(_Other(), CHAT) == []
+    assert rule.seen == 9
+
+
+def test_fault_rule_max_fires():
+    class _Ep:
+        name, id, url = "x", "x", "http://x:1"
+
+    inj = FaultInjector()
+    inj.add_rule(FaultRule(kind="connect_refused", max_fires=2))
+    fires = sum(bool(inj.decide(_Ep(), CHAT)) for _ in range(5))
+    assert fires == 2
+
+
+# -------------------------------------------------------- chaos integration
+
+
+def test_failover_nonstream_zero_client_502s():
+    """Acceptance: two stubs, one model; one endpoint hard-killed via
+    connect-refused injection. All non-streamed requests succeed, and the
+    killed endpoint receives no further traffic after its breaker trips."""
+    async def run():
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m").start()
+        dead = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            ep_dead = gw.register_mock(dead.url, ["m"], name="dead")
+            manager = _set_resilience(gw, breaker_failure_threshold=3,
+                                      breaker_open_s=60.0)
+            gw.state.faults = FaultInjector()
+            kill = gw.state.faults.add_rule(
+                FaultRule(kind="connect_refused", endpoint="dead", every_n=1)
+            )
+            headers = await gw.inference_headers()
+
+            n = 12
+            for _ in range(n):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+                await r.read()
+
+            # zero client-visible 502s; every request ended on the live stub
+            assert len(alive.requests_seen) == n
+            assert len(dead.requests_seen) == 0  # fault fired pre-socket
+            # breaker tripped after exactly the threshold of attempts, then
+            # the dead endpoint stopped receiving traffic entirely
+            assert manager.state_of(ep_dead.id) == BreakerState.OPEN
+            assert kill.seen == 3
+            summary = gw.state.metrics.summary()
+            assert summary["failover_retries_total"] == 3
+            assert summary["failover_recoveries_total"] == 3
+        finally:
+            await alive.stop()
+            await dead.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_failover_stream_pre_first_byte():
+    """Streamed requests fail over when the upstream dies before the first
+    byte reaches the client — the stream arrives intact from the peer."""
+    async def run():
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m", tokens_per_reply=3).start()
+        dead = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            gw.register_mock(dead.url, ["m"], name="dead")
+            _set_resilience(gw, breaker_failure_threshold=3)
+            gw.state.faults = FaultInjector()
+            gw.state.faults.add_rule(
+                FaultRule(kind="connect_refused", endpoint="dead", every_n=1)
+            )
+            headers = await gw.inference_headers()
+
+            for _ in range(6):
+                r = await gw.client.post(CHAT, json=_chat_body(stream=True),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+                text = (await r.read()).decode()
+                assert "data: [DONE]" in text
+                assert "event: error" not in text
+        finally:
+            await alive.stop()
+            await dead.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_midstream_cut_emits_error_frame_and_counts_outcome():
+    """A stream cut after the first byte is NOT retried (bytes already left)
+    but the client gets a final `event: error` frame and the interruption
+    lands in the per-endpoint stats + breaker + /metrics."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m", tokens_per_reply=50).start()
+        try:
+            ep = gw.register_mock(mock.url, ["m"], name="cutme")
+            manager = _set_resilience(gw, breaker_failure_threshold=2)
+            gw.state.faults = FaultInjector()
+            gw.state.faults.add_rule(
+                FaultRule(kind="stream_cut", endpoint="cutme",
+                          after_bytes=40, every_n=1)
+            )
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(stream=True),
+                                     headers=headers)
+            assert r.status == 200  # stream had already committed
+            text = (await r.read()).decode()
+            assert "event: error" in text
+            frame = text.split("event: error\ndata: ")[1].split("\n")[0]
+            err = json.loads(frame)["error"]
+            assert err["code"] == "stream_interrupted"
+
+            outcomes = gw.state.load_manager.endpoint_outcomes(ep.id)
+            assert outcomes["stream_interruptions"] == 1
+            assert manager.breaker_info(ep.id)["consecutive_failures"] == 1
+            exposition = gw.state.metrics.render()
+            assert ('llmlb_gateway_stream_interruptions_total'
+                    '{model="m",endpoint="cutme"} 1') in exposition
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_anthropic_midstream_cut_emits_native_error_event():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m", tokens_per_reply=50).start()
+        try:
+            gw.register_mock(mock.url, ["m"], name="cutme")
+            _set_resilience(gw)
+            gw.state.faults = FaultInjector()
+            gw.state.faults.add_rule(
+                FaultRule(kind="stream_cut", endpoint="cutme",
+                          after_bytes=60, every_n=1)
+            )
+            headers = await gw.inference_headers()
+            r = await gw.client.post("/v1/messages", json={
+                "model": "m", "max_tokens": 32, "stream": True,
+                "messages": [{"role": "user", "content": "hi"}],
+            }, headers=headers)
+            assert r.status == 200
+            text = (await r.read()).decode()
+            assert "event: error" in text
+            assert '"type":"error"' in text
+            assert "message_stop" not in text.split("event: error")[1]
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_retryable_5xx_fails_over():
+    """A 500 from one endpoint fails over to its peer instead of
+    normalizing straight to 502."""
+    async def run():
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m").start()
+        broken = await MockOpenAIEndpoint(model="m", fail_with=500).start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            gw.register_mock(broken.url, ["m"], name="broken")
+            _set_resilience(gw, breaker_failure_threshold=2)
+            headers = await gw.inference_headers()
+            for _ in range(8):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+            # the 500-ing endpoint was actually contacted, then benched
+            assert 1 <= len(broken.requests_seen) <= 2
+        finally:
+            await alive.stop()
+            await broken.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_all_breakers_open_gives_503_queue_semantics_not_404():
+    """Satellite: endpoints exist but every breaker is open -> the request
+    queues and 503s with Retry-After derived from the breaker, never 404."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m").start()
+        try:
+            ep = gw.register_mock(mock.url, ["m"], name="only")
+            manager = _set_resilience(gw, breaker_failure_threshold=1,
+                                      breaker_open_s=7.0)
+            # short queue timeout so the park resolves quickly
+            gw.state.load_manager.queue_config = QueueConfig(
+                queue_timeout_s=0.2)
+            manager.record_failure(ep.id)
+            assert manager.state_of(ep.id) == BreakerState.OPEN
+
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 503, await r.text()
+            retry_after = int(r.headers["Retry-After"])
+            assert 1 <= retry_after <= 7
+            body = await r.json()
+            assert body["error"]["type"] == "server_error"
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_unknown_model_still_404s():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(mock.url, ["m"])
+            _set_resilience(gw)
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(model="absent"),
+                                     headers=headers)
+            assert r.status == 404
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_retry_budget_stops_amplification():
+    """With the budget floor at zero and no recent traffic, a failing fleet
+    gets no retries at all — the 502 is immediate, not amplified."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = await MockOpenAIEndpoint(model="m", fail_with=500).start()
+        b = await MockOpenAIEndpoint(model="m", fail_with=500).start()
+        try:
+            gw.register_mock(a.url, ["m"], name="a")
+            gw.register_mock(b.url, ["m"], name="b")
+            _set_resilience(gw, retry_budget_min=0, retry_budget_ratio=0.0,
+                            breaker_failure_threshold=100)
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 502
+            # exactly one upstream attempt total: no budget, no retry
+            assert len(a.requests_seen) + len(b.requests_seen) == 1
+            exposition = gw.state.metrics.render()
+            assert "llmlb_gateway_retry_budget_exhausted_total 1" in exposition
+        finally:
+            await a.stop()
+            await b.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_body_read_failure_fails_over():
+    """Regression: an endpoint that returns 200 headers then dies mid-body
+    (truncated read) must fail over like a connect failure — and book the
+    outcome, not crash the handler to a raw 500."""
+    async def run():
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def broken_chat(request):
+            await request.read()
+            resp = web.StreamResponse(status=200, headers={
+                "Content-Type": "application/json",
+                "Content-Length": "1000",  # promises more than it sends
+            })
+            await resp.prepare(request)
+            await resp.write(b'{"partial":')
+            request.transport.close()
+            return resp
+
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", broken_chat)
+        broken = TestServer(app)
+        await broken.start_server()
+
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            ep_broken = gw.register_mock(
+                f"http://127.0.0.1:{broken.port}", ["m"], name="broken")
+            manager = _set_resilience(gw, breaker_failure_threshold=2)
+            headers = await gw.inference_headers()
+            for _ in range(6):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+            assert (gw.state.load_manager.endpoint_outcomes(ep_broken.id)
+                    ["failures"]) >= 1
+            assert manager.state_of(ep_broken.id) == BreakerState.OPEN
+        finally:
+            await alive.stop()
+            await broken.close()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_429_fails_over_but_does_not_feed_breaker():
+    """A saturated endpoint (429) is alive: its requests fail over, but
+    ejecting it would turn an overload spike into a capacity cascade, so
+    the breaker must not move."""
+    async def run():
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m").start()
+        busy = await MockOpenAIEndpoint(model="m", fail_with=429).start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            ep_busy = gw.register_mock(busy.url, ["m"], name="busy")
+            manager = _set_resilience(gw, breaker_failure_threshold=2)
+            headers = await gw.inference_headers()
+            for _ in range(8):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+            # failover happened, breaker did not trip
+            assert len(busy.requests_seen) >= 2
+            assert manager.state_of(ep_busy.id) == BreakerState.CLOSED
+        finally:
+            await alive.stop()
+            await busy.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_deleting_endpoint_clears_breaker_gauge():
+    """Regression: an endpoint removed while its breaker is open must not
+    keep exporting llmlb_gateway_breaker_state (a frozen open reading
+    would page on a nonexistent endpoint forever)."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m").start()
+        try:
+            ep = gw.register_mock(mock.url, ["m"], name="doomed")
+            manager = _set_resilience(gw, breaker_failure_threshold=1)
+            manager.record_failure(ep.id)
+            assert ('llmlb_gateway_breaker_state{endpoint="doomed"} 2'
+                    in gw.state.metrics.render())
+            admin = await gw.admin_headers()
+            r = await gw.client.delete(f"/api/endpoints/{ep.id}",
+                                       headers=admin)
+            assert r.status == 200
+            assert ('llmlb_gateway_breaker_state{endpoint="doomed"}'
+                    not in gw.state.metrics.render())
+            assert manager.state_of(ep.id) == BreakerState.CLOSED
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_half_open_probe_resolved_by_non_retryable_response():
+    """Regression: a half-open probe answered with a non-retryable 4xx must
+    resolve the probe slot (endpoint is alive) instead of wedging the
+    breaker in half_open with its only slot consumed forever."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m", fail_with=400).start()
+        try:
+            ep = gw.register_mock(mock.url, ["m"], name="flaky")
+            manager = _set_resilience(gw, breaker_failure_threshold=1,
+                                      breaker_open_s=0.05)
+            manager.record_failure(ep.id)
+            assert manager.state_of(ep.id) == BreakerState.OPEN
+            await asyncio.sleep(0.06)  # open interval elapses
+
+            headers = await gw.inference_headers()
+            # probe request: upstream answers 400 (non-retryable) -> client
+            # sees the normalized 502, breaker records liveness and closes
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 502
+            assert manager.state_of(ep.id) == BreakerState.CLOSED
+            # NOT wedged: the endpoint still receives traffic
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 502
+            assert len(mock.requests_seen) == 2
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_flap_cycle_trips_then_recovers_through_half_open():
+    """Chaos smoke: endpoint dies (trip), comes back (half-open probe
+    succeeds), and rejoins the serving pool — all via in-band signals, no
+    pull-checker involvement, zero client-visible errors throughout."""
+    async def run():
+        gw = await GatewayHarness.create()
+        stable = await MockOpenAIEndpoint(model="m").start()
+        flappy = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(stable.url, ["m"], name="stable")
+            ep_flap = gw.register_mock(flappy.url, ["m"], name="flappy")
+            manager = _set_resilience(gw, breaker_failure_threshold=2,
+                                      breaker_open_s=0.1)
+            gw.state.faults = FaultInjector()
+            rule = gw.state.faults.add_rule(
+                FaultRule(kind="connect_refused", endpoint="flappy",
+                          every_n=1)
+            )
+            headers = await gw.inference_headers()
+
+            async def burst(n):
+                for _ in range(n):
+                    r = await gw.client.post(CHAT, json=_chat_body(),
+                                             headers=headers)
+                    assert r.status == 200, await r.text()
+
+            await burst(6)  # down phase: trips after 2 in-band failures
+            assert manager.state_of(ep_flap.id) == BreakerState.OPEN
+
+            gw.state.faults.remove_rule(rule)  # endpoint comes back
+            await asyncio.sleep(0.12)  # open interval elapses
+            await burst(6)  # half-open probe succeeds -> closed + serving
+            assert manager.state_of(ep_flap.id) == BreakerState.CLOSED
+            assert len(flappy.requests_seen) >= 1
+        finally:
+            await stable.stop()
+            await flappy.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+# ------------------------------------------------- breaker <-> pull checker
+
+
+def test_health_probe_reconciles_breaker_and_recovery_resyncs_models():
+    """Satellite: offline->online re-detection + model resync, and the
+    breaker reconciling with the pull checker in both directions."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(model="m1").start()
+        try:
+            ep = gw.register_mock(mock.url, ["m1"], name="flappy")
+            manager = _set_resilience(gw, breaker_failure_threshold=1,
+                                      breaker_open_s=3600.0)
+            checker = EndpointHealthChecker(
+                gw.state.registry, gw.state.load_manager, gw.state.db,
+                gw.state.http, gw.state.events, interval_s=3600.0,
+                timeout_s=2.0, resilience=manager,
+            )
+            # in-band trip; the endpoint is still ONLINE per the registry
+            manager.record_failure(ep.id)
+            assert manager.state_of(ep.id) == BreakerState.OPEN
+            assert gw.state.registry.get(ep.id).breaker_state == "open"
+
+            # good pull probe fast-forwards the breaker to half-open
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert manager.state_of(ep.id) == BreakerState.HALF_OPEN
+            assert gw.state.registry.get(ep.id).breaker_state == "half_open"
+
+            # the next real request is the probe; success closes the breaker
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body("m1"),
+                                     headers=headers)
+            assert r.status == 200
+            assert manager.state_of(ep.id) == BreakerState.CLOSED
+
+            # now kill it for the pull checker: two strikes -> OFFLINE
+            manager.record_failure(ep.id)
+            port = mock.server.port
+            await mock.stop()
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert (gw.state.registry.get(ep.id).status
+                    == EndpointStatus.OFFLINE)
+
+            # recovery on the same port with a NEW model set: back online,
+            # models resynced, breaker reset to closed
+            from aiohttp import web
+            from aiohttp.test_utils import TestServer as TS
+            mock2 = MockOpenAIEndpoint(model="m2")
+            app = web.Application()
+            app.router.add_get("/v1/models", mock2._models)
+            mock2.server = TS(app, port=port)
+            await mock2.server.start_server()
+            try:
+                await checker.check_endpoint(gw.state.registry.get(ep.id))
+                ep_after = gw.state.registry.get(ep.id)
+                assert ep_after.status == EndpointStatus.ONLINE
+                models = [m.model_id
+                          for m in gw.state.registry.models_for(ep.id)]
+                assert models == ["m2"]
+                assert manager.state_of(ep.id) == BreakerState.CLOSED
+                assert ep_after.breaker_state == "closed"
+            finally:
+                await mock2.server.close()
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_api_health_and_metrics_surfaces():
+    """Breaker state + retry/failover counters visible in /api/health and
+    /metrics (acceptance), and /api/health needs no auth."""
+    async def run():
+        gw = await GatewayHarness.create()
+        alive = await MockOpenAIEndpoint(model="m").start()
+        dead = await MockOpenAIEndpoint(model="m").start()
+        try:
+            gw.register_mock(alive.url, ["m"], name="alive")
+            ep_dead = gw.register_mock(dead.url, ["m"], name="dead")
+            _set_resilience(gw, breaker_failure_threshold=2,
+                            breaker_open_s=60.0)
+            gw.state.faults = FaultInjector()
+            gw.state.faults.add_rule(
+                FaultRule(kind="connect_refused", endpoint="dead", every_n=1)
+            )
+            headers = await gw.inference_headers()
+            for _ in range(6):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200
+
+            r = await gw.client.get("/api/health")  # unauthenticated
+            assert r.status == 200
+            health = await r.json()
+            by_name = {e["name"]: e for e in health["endpoints"]}
+            assert by_name["dead"]["breaker"]["state"] == "open"
+            assert by_name["dead"]["breaker"]["retry_after_s"] > 0
+            assert by_name["alive"]["breaker"]["state"] == "closed"
+            assert by_name["alive"]["outcomes"]["successes"] >= 1
+            assert health["endpoints_serving"] == 1
+            assert health["resilience"]["retry_budget"]["requests_in_window"] >= 6
+            assert health["faults"][0]["fires"] == 2
+
+            r = await gw.client.get("/metrics")
+            text = await r.text()
+            assert 'llmlb_gateway_breaker_state{endpoint="dead"} 2' in text
+            assert ('llmlb_gateway_breaker_transitions_total'
+                    '{endpoint="dead",to="open"} 1') in text
+            assert ('llmlb_gateway_failover_retries_total'
+                    '{model="m",reason="connect_error"} 2') in text
+            assert ('llmlb_gateway_failover_recoveries_total'
+                    '{model="m"} 2') in text
+            assert ('llmlb_gateway_faults_injected_total'
+                    '{kind="connect_refused"} 2') in text
+
+            # /api/endpoints carries the breaker state too
+            admin = await gw.admin_headers()
+            r = await gw.client.get("/api/endpoints", headers=admin)
+            eps = (await r.json())["endpoints"]
+            states = {e["name"]: e["breaker_state"] for e in eps}
+            assert states == {"alive": "closed", "dead": "open"}
+            assert gw.state.registry.get(ep_dead.id).breaker_state == "open"
+        finally:
+            await alive.stop()
+            await dead.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_queue_timeout_503_carries_retry_after():
+    """Satellite: plain capacity 503 (no breakers involved) also carries a
+    Retry-After derived from the queue config."""
+    async def run():
+        gw = await GatewayHarness.create()
+        slow = await MockOpenAIEndpoint(model="m", reply_delay_s=1.0).start()
+        try:
+            gw.register_mock(slow.url, ["m"], name="slow")
+            _set_resilience(gw)
+            gw.state.load_manager.queue_config = QueueConfig(
+                queue_timeout_s=0.15, max_active_per_endpoint=1)
+            headers = await gw.inference_headers()
+            blocker = asyncio.create_task(gw.client.post(
+                CHAT, json=_chat_body(), headers=headers))
+            await asyncio.sleep(0.05)  # let it occupy the only slot
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 503
+            assert int(r.headers["Retry-After"]) >= 1
+            resp = await blocker
+            assert resp.status == 200
+        finally:
+            await slow.stop()
+            await gw.close()
+    asyncio.run(run())
