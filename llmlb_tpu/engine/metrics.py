@@ -21,6 +21,10 @@ ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 # prompt groups), a decode step is single-digit ms on TPU (burst-amortized).
 STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                 1.0, 2.5)
+# Schema→DFA→mask-table compiles: milliseconds for byte-level vocabularies,
+# seconds for 128k-token vocabularies (docs/structured-outputs.md sizing).
+COMPILE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0)
 
 
 class Histogram:
@@ -92,6 +96,18 @@ class EngineMetrics:
         self.prefix_insertions_total = 0
         self.prefix_inserted_tokens_total = 0
         self.prefix_evictions_total = 0
+        # Structured outputs (llmlb_tpu/structured): constrained requests
+        # served, decode dispatches that applied a grammar mask, requests
+        # that ended without grammar acceptance, schema→mask compile cost,
+        # and the compiled-mask LRU cache traffic. The cache-size gauges
+        # (entries/bytes) are scraped from the compiler at render time.
+        self.structured_requests_total = 0
+        self.masked_decode_steps_total = 0
+        self.constraint_violations_total = 0
+        self.mask_cache_hits_total = 0
+        self.mask_cache_misses_total = 0
+        self.mask_cache_evictions_total = 0
+        self.schema_compile = Histogram(COMPILE_BUCKETS)
 
     # ------------------------------------------------------------ recorders
 
@@ -148,6 +164,36 @@ class EngineMetrics:
         with self._lock:
             self.prefix_evictions_total += 1
 
+    def record_structured_request(self) -> None:
+        with self._lock:
+            self.structured_requests_total += 1
+
+    def record_masked_decode_step(self) -> None:
+        with self._lock:
+            self.masked_decode_steps_total += 1
+
+    def record_constraint_violation(self) -> None:
+        """A constrained request terminated without grammar acceptance
+        (max_tokens/capacity cut it short, or a vocabulary gap forced EOS)."""
+        with self._lock:
+            self.constraint_violations_total += 1
+
+    def record_schema_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.schema_compile.observe(seconds)
+
+    def record_mask_cache_hit(self) -> None:
+        with self._lock:
+            self.mask_cache_hits_total += 1
+
+    def record_mask_cache_miss(self) -> None:
+        with self._lock:
+            self.mask_cache_misses_total += 1
+
+    def record_mask_cache_eviction(self) -> None:
+        with self._lock:
+            self.mask_cache_evictions_total += 1
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -175,16 +221,22 @@ class EngineMetrics:
                 "prefix_misses_total": self.prefix_misses_total,
                 "prefix_cached_tokens_total": self.prefix_cached_tokens_total,
                 "prefix_evictions_total": self.prefix_evictions_total,
+                "structured_requests_total": self.structured_requests_total,
+                "constraint_violations_total":
+                    self.constraint_violations_total,
+                "schema_compile_p50_s": self.schema_compile.percentile(50),
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
                num_slots: int, prefix_cache: dict | None = None,
-               kv_cache: dict | None = None) -> str:
+               kv_cache: dict | None = None,
+               structured: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
         kv_cache_info() block — page-pool gauges render when the paged
-        layout is active."""
+        layout is active; `structured` is the constraint compiler's info()
+        block (mask-cache size gauges)."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -221,7 +273,33 @@ class EngineMetrics:
                 "# TYPE llmlb_engine_prefix_cache_evictions_total counter",
                 "llmlb_engine_prefix_cache_evictions_total "
                 f"{self.prefix_evictions_total}",
+                "# TYPE llmlb_engine_structured_requests_total counter",
+                "llmlb_engine_structured_requests_total "
+                f"{self.structured_requests_total}",
+                "# TYPE llmlb_engine_masked_decode_steps_total counter",
+                "llmlb_engine_masked_decode_steps_total "
+                f"{self.masked_decode_steps_total}",
+                "# TYPE llmlb_engine_constraint_violations_total counter",
+                "llmlb_engine_constraint_violations_total "
+                f"{self.constraint_violations_total}",
+                "# TYPE llmlb_engine_mask_cache_hits_total counter",
+                f"llmlb_engine_mask_cache_hits_total {self.mask_cache_hits_total}",
+                "# TYPE llmlb_engine_mask_cache_misses_total counter",
+                "llmlb_engine_mask_cache_misses_total "
+                f"{self.mask_cache_misses_total}",
+                "# TYPE llmlb_engine_mask_cache_evictions_total counter",
+                "llmlb_engine_mask_cache_evictions_total "
+                f"{self.mask_cache_evictions_total}",
             ]
+            if structured is not None and structured.get("enabled"):
+                lines += [
+                    "# TYPE llmlb_engine_mask_cache_entries gauge",
+                    "llmlb_engine_mask_cache_entries "
+                    f"{structured['mask_cache_entries']}",
+                    "# TYPE llmlb_engine_mask_cache_bytes gauge",
+                    "llmlb_engine_mask_cache_bytes "
+                    f"{structured['mask_cache_bytes']}",
+                ]
             if prefix_cache is not None and prefix_cache.get("enabled"):
                 lines += [
                     "# TYPE llmlb_engine_prefix_cache_entries gauge",
@@ -267,6 +345,7 @@ class EngineMetrics:
                 ("llmlb_engine_itl_seconds", self.itl),
                 ("llmlb_engine_prefill_step_seconds", self.prefill_step),
                 ("llmlb_engine_decode_step_seconds", self.decode_step),
+                ("llmlb_engine_schema_compile_seconds", self.schema_compile),
             ):
                 lines.append(f"# TYPE {name} histogram")
                 cumulative = 0

@@ -48,6 +48,7 @@ from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
 from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
+from llmlb_tpu.structured.constraint import ConstraintState, TokenConstraint
 
 log = logging.getLogger("llmlb_tpu.engine")
 
@@ -139,6 +140,14 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = 0
     max_tokens: int = 128
+    # Per-request deterministic sampling: rows with a seed draw from
+    # fold_in(PRNGKey(seed), position) instead of the shared batch key, so
+    # the token sequence reproduces regardless of batch composition.
+    seed: int | None = None
+    # Grammar constraint spec (llmlb_tpu/structured.spec_regex forms) —
+    # JSON-safe, so it rides the multihost plan wire as-is. The compiled
+    # token-DFA travels separately on Request.compiled_constraint.
+    constraint: dict | None = None
 
 
 @dataclasses.dataclass
@@ -154,6 +163,11 @@ class Request:
     # Set by the consumer (stop hit / client gone); the step loop frees the slot
     # at its next emit for this request. Plain bool write — atomic under the GIL.
     cancelled: bool = False
+    # Compiled token-DFA for sampling.constraint (llmlb_tpu/structured).
+    # The service pre-compiles it off the step loop; multihost followers and
+    # direct core submitters get it compiled at insert via the core's
+    # constraint_compiler. Never serialized — followers rebuild from the spec.
+    compiled_constraint: TokenConstraint | None = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -181,6 +195,10 @@ class _Slot:
     # cost a full host↔device round trip each (93 ms over the axon tunnel)
     # and serialized TTFT under bursty load.
     first_pending: bool = False
+    # Grammar-constraint cursor (llmlb_tpu/structured.ConstraintState),
+    # advanced host-side on every emitted token; its bias row is this slot's
+    # stripe of the [B, V] decode mask.
+    constraint: ConstraintState | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -440,7 +458,28 @@ class EngineCore:
         self._d_top_ps = jnp.ones((num_slots,), jnp.float32)
         self._d_top_ks = jnp.zeros((num_slots,), jnp.int32)
         self._d_last_tokens = jnp.zeros((num_slots,), jnp.int32)
+        # Per-slot sampling seeds (-1 = shared batch key); always passed to
+        # sample_tokens — unseeded rows are bit-identical to the pre-seed
+        # path, so goldens hold.
+        self._d_seeds = jnp.full((num_slots,), -1, jnp.int32)
         self._key = jax.random.PRNGKey(seed)
+
+        # Grammar-constraint mask: one float32 [slots, V] additive bias
+        # (0 allowed / -1e30 blocked), host-mutated as slot FSMs advance and
+        # re-shipped before the next masked dispatch. Lazily allocated — an
+        # engine that never sees a constrained request never pays the HBM or
+        # the H2D, and sample_tokens gets mask_bias=None (the original
+        # compiled path, bit for bit). Compiler is installed by the service
+        # layer (it owns the tokenizer); direct-core users may leave it None
+        # and pre-compile Request.compiled_constraint themselves.
+        self.constraint_compiler = None
+        self._mask_bias: np.ndarray | None = None
+        self._d_mask: jnp.ndarray | None = None
+        # Rows changed since the last device sync: one FSM advance dirties
+        # ONE row, and shipping only those keeps the per-token H2D at
+        # rows×V·4B instead of slots×V·4B (32 MiB/token at 64×128k).
+        self._mask_dirty_rows: set[int] = set()
+        self._constrained_count = 0
 
         # Decode burst: number of decode+sample steps fused into ONE device
         # dispatch (lax.scan with on-device token feedback) per host readback.
@@ -538,7 +577,7 @@ class EngineCore:
             args.append(plain(self._d_block_tables))
         args += [
             plain(self._d_temps), plain(self._d_top_ps),
-            plain(self._d_top_ks),
+            plain(self._d_top_ks), plain(self._d_seeds),
             plain(self._key),  # split keys keep this shape/dtype
         ]
         for w in self._window_buckets:
@@ -898,6 +937,12 @@ class EngineCore:
                     self.metrics.record_request_done("length")
                     self._cancelled_effective.discard(request.request_id)
                     self._free_slot_kv(i)
+                    if slot.constraint is not None:
+                        # only an UNaccepted grammar cut short is a violation
+                        # (same rule as the length path in _emit)
+                        if not slot.constraint.is_accepting:
+                            self.metrics.record_constraint_violation()
+                        self._clear_constraint(i)
                     slot.request = None
                     slot.generated = 0
                     slot.last_emit_at = 0.0
@@ -946,6 +991,13 @@ class EngineCore:
                 request.events.put(
                     ("error", "prompt does not fit slot capacity")
                 )
+                self.metrics.record_request_done("error")
+                handled = True
+                continue
+            try:
+                self._prepare_constraint(request)
+            except Exception as e:
+                request.events.put(("error", f"constraint rejected: {e}"))
                 self.metrics.record_request_done("error")
                 handled = True
                 continue
@@ -1010,6 +1062,7 @@ class EngineCore:
             # their event queues silent forever.
             self.slots[slot_id].request = request
             self.slots[slot_id].generated = 0
+            self._attach_constraint(slot_id, request)
             batch.append((slot_id, request, n))
 
         if not batch:
@@ -1041,6 +1094,7 @@ class EngineCore:
         # _advance_prefill feed chunks between decode steps.
         slot.request = request
         slot.generated = 0
+        self._attach_constraint(slot_id, request)
         slot.prefilling = True
         slot.prefill_pos = 0
         self._seq_lens[slot_id] = 0
@@ -1087,6 +1141,7 @@ class EngineCore:
         slot = self.slots[slot_id]
         slot.request = request
         slot.generated = 0
+        self._attach_constraint(slot_id, request)
         slot.prefilling = True
         slot.prefill_pos = use_len
         slot.cache_entry = entry
@@ -1111,6 +1166,76 @@ class EngineCore:
             )
             self.kv_copy_dispatches += 1
         self.metrics.record_prefix_hit(use_len)
+
+    # ------------------------------------------------------------ constraints
+
+    def _prepare_constraint(self, request: Request) -> None:
+        """Ensure a constrained request carries its compiled token-DFA before
+        a slot is claimed. The service layer pre-compiles off the step loop;
+        this is the fallback for multihost followers (which only receive the
+        JSON spec over the plan wire) and direct core submitters. Raises for
+        uncompilable specs — the caller turns that into a terminal event.
+
+        Known cost: on a follower a COLD schema compiles here, on the step
+        loop, stalling decode for the compile (large vocabularies: seconds).
+        The leader stalls identically at its own service-level compile and
+        the LRU makes it once-per-schema, so lockstep stays aligned — but a
+        multihost fleet serving many distinct cold schemas pays it per
+        schema (docs/structured-outputs.md)."""
+        if (request.compiled_constraint is None
+                and request.sampling.constraint is not None):
+            if self.constraint_compiler is None:
+                raise ValueError(
+                    "request carries a constraint but the engine has no "
+                    "constraint compiler"
+                )
+            request.compiled_constraint = self.constraint_compiler.compile_spec(
+                request.sampling.constraint
+            )
+
+    def _attach_constraint(self, slot_id: int, request: Request) -> None:
+        """Install the per-request FSM cursor and its initial mask stripe at
+        slot-claim time (every insert path funnels through here)."""
+        if request.compiled_constraint is None:
+            return
+        state = ConstraintState(request.compiled_constraint)
+        self.slots[slot_id].constraint = state
+        self._constrained_count += 1
+        self.metrics.record_structured_request()
+        self._set_mask_row(slot_id, state)
+
+    def _set_mask_row(self, slot_id: int, state: ConstraintState) -> None:
+        if self._mask_bias is None:
+            self._mask_bias = np.zeros(
+                (self.num_slots, self.cfg.vocab_size), np.float32
+            )
+        self._mask_bias[slot_id] = state.bias_row()
+        self._mask_dirty_rows.add(slot_id)
+
+    def _clear_constraint(self, slot_id: int) -> None:
+        slot = self.slots[slot_id]
+        if slot.constraint is None:
+            return
+        slot.constraint = None
+        self._constrained_count -= 1
+        if self._mask_bias is not None:
+            self._mask_bias[slot_id] = 0.0
+            self._mask_dirty_rows.add(slot_id)
+
+    def _sync_mask(self) -> jnp.ndarray:
+        """Device mirror of the mask, refreshed per DIRTY ROW (same
+        small-H2D contract as the paged block tables — an FSM advance
+        touches one row, so only that row ships)."""
+        if self._d_mask is None:
+            self._d_mask = jnp.asarray(self._mask_bias)
+            self._mask_dirty_rows.clear()
+        elif self._mask_dirty_rows:
+            rows = sorted(self._mask_dirty_rows)
+            self._d_mask = self._d_mask.at[jnp.asarray(rows, jnp.int32)].set(
+                jnp.asarray(self._mask_bias[rows])
+            )
+            self._mask_dirty_rows.clear()
+        return self._d_mask
 
     def _release_cache_entry(self, slot: _Slot) -> None:
         if slot.cache_entry is not None:
@@ -1201,6 +1326,15 @@ class EngineCore:
             info["pinned_hbm_bytes"] = (
                 pinned * kv_cache_bytes(self.cfg, 1, self.slot_capacity)
             )
+        return info
+
+    def structured_info(self) -> dict:
+        """Structured-output block for /api/system, /api/health, /metrics:
+        the constraint compiler's mask-cache figures plus live load."""
+        if self.constraint_compiler is None:
+            return {"enabled": False}
+        info = self.constraint_compiler.info()
+        info["active_constrained_slots"] = self._constrained_count
         return info
 
     def kv_cache_info(self) -> dict:
@@ -1310,28 +1444,71 @@ class EngineCore:
         temps = np.ones((padded,), np.float32)
         top_ps = np.ones((padded,), np.float32)
         top_ks = np.zeros((padded,), np.int32)
+        seeds = np.full((padded,), -1, np.int32)
         for row, (_slot_id, request, _n) in enumerate(group):
             s = request.sampling
             temps[row] = s.temperature
             top_ps[row] = s.top_p
             top_ks[row] = s.top_k
+            if s.seed is not None:
+                seeds[row] = s.seed & 0x7FFFFFFF
         temps[len(group):] = temps[len(group) - 1]
         top_ps[len(group):] = top_ps[len(group) - 1]
         top_ks[len(group):] = top_ks[len(group) - 1]
+        seeds[len(group):] = seeds[len(group) - 1]
+
+        # Constrained rows mask their first-token sampling too: the bias is
+        # each slot's FSM start-state row (padding repeats the last real row,
+        # so its duplicate scatter writes the same value).
+        constrained = [
+            (row, self.slots[slot_id].constraint)
+            for row, (slot_id, _r, _n) in enumerate(group)
+            if self.slots[slot_id].constraint is not None
+        ]
+        mask = None
+        if constrained:
+            bias = np.zeros((padded, logits.shape[-1]), np.float32)
+            for row, state in constrained:
+                bias[row] = state.bias_row()
+            bias[len(group):] = bias[len(group) - 1]
+            mask = jnp.asarray(bias)
 
         self._key, sk = jax.random.split(self._key)
         d_temps = jnp.asarray(temps)
         d_top_ps = jnp.asarray(top_ps)
         d_top_ks = jnp.asarray(top_ks)
-        firsts = sample_tokens(logits, sk, d_temps, d_top_ps, d_top_ks)
+        d_seeds = jnp.asarray(seeds)
+        # steps = lens - 1: decode dispatches sample with the PRE-increment
+        # seq_len, so the first decode token uses step = prompt_len — the
+        # activation sample must fold a DIFFERENT step or a seeded request's
+        # first two tokens would draw from the same per-row key.
+        firsts = sample_tokens(logits, sk, d_temps, d_top_ps, d_top_ks,
+                               mask, d_seeds, jnp.asarray(padded_lens) - 1)
         idx = jnp.asarray(padded_slot_ids)
         self._d_temps = self._d_temps.at[idx].set(d_temps)
         self._d_top_ps = self._d_top_ps.at[idx].set(d_top_ps)
         self._d_top_ks = self._d_top_ks.at[idx].set(d_top_ks)
+        self._d_seeds = self._d_seeds.at[idx].set(d_seeds)
         self._d_seq_lens = self._d_seq_lens.at[idx].set(
             jnp.asarray(padded_lens)
         )
         self._d_last_tokens = self._d_last_tokens.at[idx].set(firsts)
+
+        if constrained:
+            # The NEXT decode dispatch needs each constrained slot's mask
+            # advanced past its first token, which only exists on device —
+            # one synchronous fetch per constrained activation (the
+            # constrained-TTFT cost documented in docs/structured-outputs.md;
+            # unconstrained slots keep the zero-sync deferred-first path).
+            first_host = self._fetch_tokens(firsts)
+            for row, (slot_id, _r, _n) in enumerate(group):
+                state = self.slots[slot_id].constraint
+                if state is None:
+                    continue
+                if state.advance(int(first_host[row])):
+                    self._set_mask_row(slot_id, state)
+                else:
+                    self.metrics.record_constraint_violation()
 
         for slot_id, request, n in group:
             self._seq_lens[slot_id] = n
@@ -1384,6 +1561,7 @@ class EngineCore:
         slot = self.slots[slot_id]
         slot.request = request
         slot.generated = 0
+        self._attach_constraint(slot_id, request)
         self._activate_slot(slot_id, request, n, logits)
 
     def _advance_prefill(self) -> bool:
@@ -1405,6 +1583,7 @@ class EngineCore:
             self._cancelled_effective.discard(request.request_id)
             self._release_cache_entry(slot)
             self._free_slot_kv(slot_id)
+            self._clear_constraint(slot_id)
             slot.request = None
             slot.prefilling = False
             slot.generated = 0
@@ -1487,7 +1666,7 @@ class EngineCore:
 
         if self.page_pool is not None:
             def many(params, last, lens, cache_k, cache_v, tables,
-                     temps, top_ps, top_ks, key):
+                     temps, top_ps, top_ks, seeds, key):
                 keys = jax.random.split(key, k)
 
                 def body(carry, step_key):
@@ -1497,7 +1676,7 @@ class EngineCore:
                         window=window,
                     )
                     toks = sample_tokens(logits, step_key, temps, top_ps,
-                                         top_ks)
+                                         top_ks, None, seeds, lens)
                     return (toks, lens + 1, ck, cv), toks
 
                 first_in = last  # pre-burst tokens: pending first emissions
@@ -1510,7 +1689,7 @@ class EngineCore:
             return jax.jit(many, donate_argnums=(3, 4))
 
         def many(params, last, lens, cache_k, cache_v,
-                 temps, top_ps, top_ks, key):
+                 temps, top_ps, top_ks, seeds, key):
             keys = jax.random.split(key, k)
 
             def body(carry, step_key):
@@ -1518,7 +1697,8 @@ class EngineCore:
                 logits, ck, cv = family.decode_step(
                     params, cfg, last, lens, ck, cv, mesh, window=window
                 )
-                toks = sample_tokens(logits, step_key, temps, top_ps, top_ks)
+                toks = sample_tokens(logits, step_key, temps, top_ps, top_ks,
+                                     None, seeds, lens)
                 return (toks, lens + 1, ck, cv), toks
 
             first_in = last  # pre-burst tokens: pending first emissions
@@ -1563,6 +1743,17 @@ class EngineCore:
 
         self._key, sk = jax.random.split(self._key)
         k = self.decode_burst
+        # Constrained slots advance a host-side FSM per token, so their mask
+        # cannot be updated mid-burst: any constrained slot in the batch
+        # forces single-step decode for this dispatch (the constrained-TPS
+        # cost documented in docs/structured-outputs.md). CPU engines default
+        # to burst 1 anyway; on TPU a mixed batch trades burst amortization
+        # for grammar enforcement only while constraints are in flight.
+        constrained_active = self._constrained_count > 0 and any(
+            self.slots[i].constraint is not None for i in active
+        )
+        if k > 1 and constrained_active:
+            k = 1
         if k > 1:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
@@ -1571,14 +1762,16 @@ class EngineCore:
                  self.cache_v, toks_dev) = self._decode_many_for(window)(
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v, self._d_block_tables,
-                    self._d_temps, self._d_top_ps, self._d_top_ks, sk,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, sk,
                 )
             else:
                 (self._d_last_tokens, self._d_seq_lens, self.cache_k,
                  self.cache_v, toks_dev) = self._decode_many_for(window)(
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v,
-                    self._d_temps, self._d_top_ps, self._d_top_ks, sk,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, sk,
                 )
             tokens = self._fetch_tokens(toks_dev)  # ONE D2H sync per k tokens
             # Tokens reach the host back-to-back, so wall-clock gaps between
@@ -1614,8 +1807,12 @@ class EngineCore:
                 self.mesh,
                 window=self._window_for(active, 1),
             )
+        mask = self._sync_mask() if constrained_active else None
+        if mask is not None:
+            self.metrics.record_masked_decode_step()
         tokens_dev = sample_tokens(
-            logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks
+            logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks,
+            mask, self._d_seeds, self._d_seq_lens,
         )
         self._d_last_tokens = tokens_dev
         self._d_seq_lens = self._d_seq_lens + 1
@@ -1641,7 +1838,10 @@ class EngineCore:
             slot = self.slots[i]
             if slot.first_pending and slot.request is not None:
                 slot.first_pending = False
-                self._emit(i, int(tokens[0, i]))
+                # first=True: the grammar FSM already advanced on this token
+                # at activation (the synchronous fetch there) — advancing
+                # again would double-step the grammar.
+                self._emit(i, int(tokens[0, i]), first=True)
         for t in range(1, tokens.shape[0]):
             for i in active:
                 slot = self.slots[i]
@@ -1651,10 +1851,12 @@ class EngineCore:
                 self._emit(i, int(tokens[t, i]), itl=itl)
 
     def _emit(self, slot_id: int, token: int,
-              itl: float | None = None) -> None:
+              itl: float | None = None, first: bool = False) -> None:
         """Deliver one generated token. `itl` overrides the wall-clock
         inter-token gap (burst decode delivers k tokens back-to-back; the
-        caller passes the amortized pacing instead)."""
+        caller passes the amortized pacing instead). `first` marks the
+        deferred first emission, whose grammar advance already happened at
+        activation."""
         slot = self.slots[slot_id]
         request = slot.request
         assert request is not None
@@ -1664,6 +1866,7 @@ class EngineCore:
             self.metrics.record_request_done("cancelled")
             self._cancelled_effective.discard(request.request_id)
             self._free_slot_kv(slot_id)
+            self._clear_constraint(slot_id)
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
@@ -1684,6 +1887,17 @@ class EngineCore:
         with self._lock:
             self.total_tokens += 1
 
+        # Advance the grammar FSM on every sampled token; the updated mask
+        # row governs the NEXT dispatch. The mask makes a disallowed sample
+        # impossible, so advance() failing means a vocabulary gap forced the
+        # EOS fallback — counted, not crashed on.
+        state = slot.constraint
+        if state is not None and not first:
+            if not state.advance(token):
+                self.metrics.record_constraint_violation()
+            elif token != self.eos_id:
+                self._set_mask_row(slot_id, state)
+
         finish: str | None = None
         if token == self.eos_id:
             finish = "stop"
@@ -1691,6 +1905,10 @@ class EngineCore:
             finish = "length"
         elif self._seq_lens[slot_id] + 1 >= self.slot_capacity:
             finish = "length"
+        if (finish is not None and finish != "stop" and state is not None
+                and not state.is_accepting):
+            # cut short (max_tokens / capacity) before grammar acceptance
+            self.metrics.record_constraint_violation()
 
         if finish == "stop":
             pass  # EOS itself is not emitted as content
@@ -1709,6 +1927,7 @@ class EngineCore:
                 # head's pages and the slot frees immediately below.
                 self._maybe_cache_prefix(slot_id, request)
             self._free_slot_kv(slot_id)
+            self._clear_constraint(slot_id)
             slot.request = None
             slot.generated = 0
             slot.last_emit_at = 0.0
@@ -1722,6 +1941,7 @@ class EngineCore:
                 slot.request = None
             self._release_cache_entry(slot)
             self._free_slot_kv(slot_id)
+            self._clear_constraint(slot_id)
             slot.prefilling = False
             slot.prefill_pos = 0
             slot.generated = 0

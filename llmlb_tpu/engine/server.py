@@ -24,8 +24,14 @@ from aiohttp import web
 from llmlb_tpu import __version__
 from llmlb_tpu.engine.scheduler import SamplingParams
 from llmlb_tpu.engine.service import Engine, EngineError
+from llmlb_tpu.structured import inspect_request, parse_seed
 
 log = logging.getLogger("llmlb_tpu.engine.server")
+
+# Echoed as `system_fingerprint` on chat completions: one serving-stack
+# identity per engine build, so clients pairing it with `seed` can tell
+# "same fingerprint + same seed => same tokens" apart from a stack change.
+SYSTEM_FINGERPRINT = f"fp_llmlb_tpu_{__version__}"
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # parity: reference caps /v1/* at 20 MiB
 
@@ -116,7 +122,11 @@ class EngineAPI:
     # ------------------------------------------------------------- inventory
 
     async def list_models(self, request: web.Request) -> web.Response:
-        caps = ["chat_completion"]
+        # structured_outputs: grammar-constrained decoding is a property of
+        # the engine (llmlb_tpu/structured), advertised so the gateway's
+        # capability routing steers constrained requests here and away from
+        # endpoints that would ignore response_format.
+        caps = ["chat_completion", "structured_outputs"]
         if self.engine.supports_embeddings():
             caps.append("embeddings")
 
@@ -264,7 +274,7 @@ class EngineAPI:
         text = core.metrics.render(
             queue_depth=stats.queued, active_slots=stats.active_slots,
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
-            kv_cache=core.kv_cache_info(),
+            kv_cache=core.kv_cache_info(), structured=core.structured_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -281,6 +291,7 @@ class EngineAPI:
                 # paged mode reports live page-pool utilization; dense mode
                 # the static slot-cache footprint
                 "kv_cache": self.engine.core.kv_cache_info(),
+                "structured": self.engine.core.structured_info(),
             }
         )
 
@@ -369,7 +380,19 @@ class EngineAPI:
             prompt_ids = self.engine.encode_chat(messages)
         except Exception as e:
             return _error(400, f"failed to encode messages: {e}")
-        sampling = _sampling_from(body)
+        # Structured outputs: response_format (json_object / json_schema) or
+        # a forced tool_choice compile to a grammar constraint the scheduler
+        # enforces token by token. Malformed or uncompilable requests 400
+        # here with the offending feature named.
+        try:
+            structured = inspect_request(body)
+            sampling = _sampling_from(body)
+            sampling.seed = parse_seed(body)
+        except ValueError as e:
+            return _error(400, str(e))
+        if structured is not None:
+            sampling.constraint = structured.spec
+        tool_name = structured.tool_name if structured is not None else None
         stops = _stops_from(body)
 
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -383,6 +406,7 @@ class EngineAPI:
                     (body.get("stream_options") or {}).get("include_usage", True)
                 ),
                 request_id=rid,
+                tool_name=tool_name,
             )
 
         try:
@@ -392,17 +416,35 @@ class EngineAPI:
             return _error(500, str(e), "server_error")
         except ValueError as e:
             return _error(400, str(e))
+        if tool_name is not None:
+            # Forced tool call: the constrained output IS the arguments
+            # object; grammar acceptance maps to finish_reason "tool_calls".
+            message: dict = {
+                "role": "assistant",
+                "content": None,
+                "tool_calls": [{
+                    "id": f"call_{uuid.uuid4().hex[:24]}",
+                    "type": "function",
+                    "function": {"name": tool_name, "arguments": result.text},
+                }],
+            }
+            finish = ("tool_calls" if result.finish_reason == "stop"
+                      else result.finish_reason)
+        else:
+            message = {"role": "assistant", "content": result.text}
+            finish = result.finish_reason
         return web.json_response(
             {
                 "id": completion_id,
                 "object": "chat.completion",
                 "created": created,
                 "model": model,
+                "system_fingerprint": SYSTEM_FINGERPRINT,
                 "choices": [
                     {
                         "index": 0,
-                        "message": {"role": "assistant", "content": result.text},
-                        "finish_reason": result.finish_reason,
+                        "message": message,
+                        "finish_reason": finish,
                     }
                 ],
                 "usage": _usage(result.prompt_tokens, result.completion_tokens),
@@ -413,6 +455,7 @@ class EngineAPI:
     async def _stream_chat(
         self, request, completion_id, created, model, prompt_ids, sampling, stops,
         include_usage: bool, request_id: str | None = None,
+        tool_name: str | None = None,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -431,19 +474,37 @@ class EngineAPI:
                 "object": "chat.completion.chunk",
                 "created": created,
                 "model": model,
+                "system_fingerprint": SYSTEM_FINGERPRINT,
                 "choices": [
                     {"index": 0, "delta": delta, "finish_reason": finish}
                 ],
             }
 
         await _sse_send(resp, chunk({"role": "assistant", "content": ""}))
+        if tool_name is not None:
+            # Forced tool call: open the call in the first tool delta (id +
+            # name), then stream the constrained arguments as fragments —
+            # the shape OpenAI SDKs and the Anthropic stream re-encoder
+            # (gateway/api_anthropic.AnthropicStreamEncoder) both consume.
+            await _sse_send(resp, chunk({"tool_calls": [{
+                "index": 0,
+                "id": f"call_{uuid.uuid4().hex[:24]}",
+                "type": "function",
+                "function": {"name": tool_name, "arguments": ""},
+            }]}))
         usage = _usage(len(prompt_ids), 0)
         finish = "stop"
         try:
             async for delta in self.engine.stream(prompt_ids, sampling, stops,
                                                   request_id=request_id):
                 if delta.text:
-                    await _sse_send(resp, chunk({"content": delta.text}))
+                    if tool_name is not None:
+                        await _sse_send(resp, chunk({"tool_calls": [{
+                            "index": 0,
+                            "function": {"arguments": delta.text},
+                        }]}))
+                    else:
+                        await _sse_send(resp, chunk({"content": delta.text}))
                 if delta.finish_reason is not None:
                     finish = delta.finish_reason
                     usage = _usage(delta.prompt_tokens, delta.completion_tokens)
@@ -451,6 +512,8 @@ class EngineAPI:
             await _sse_send(resp, {"error": {"message": str(e)}})
             await resp.write(b"data: [DONE]\n\n")
             return resp
+        if tool_name is not None and finish == "stop":
+            finish = "tool_calls"
         await _sse_send(resp, chunk({}, finish))
         if include_usage:
             final = chunk({}, None)

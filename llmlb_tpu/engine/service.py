@@ -51,6 +51,16 @@ class Engine:
             max_workers=max(32, core.num_slots * 4),
             thread_name_prefix="engine-events",
         )
+        # Grammar-constraint compiler (llmlb_tpu/structured): owned here
+        # because it needs the tokenizer, installed on the core so multihost
+        # followers (which receive only the JSON spec over the plan wire)
+        # can rebuild the token-DFA themselves.
+        from llmlb_tpu.structured import ConstraintCompiler
+
+        self.constraint_compiler = ConstraintCompiler(
+            tokenizer, core.cfg.vocab_size, metrics=core.metrics
+        )
+        core.constraint_compiler = self.constraint_compiler
 
     # ------------------------------------------------------------ construction
 
@@ -134,6 +144,17 @@ class Engine:
         else:
             request = Request(prompt_ids=prompt_ids, sampling=sampling)
         loop = asyncio.get_running_loop()
+        if sampling.constraint is not None:
+            # Compile (or LRU-fetch) the token-DFA BEFORE submit, off the
+            # event loop AND off the engine step loop: a cold 128k-vocab
+            # compile must stall neither other HTTP requests nor in-flight
+            # decode. Invalid specs raise here (ValueError →
+            # UnsupportedSchemaError included) and never reach a slot.
+            request.compiled_constraint = await loop.run_in_executor(
+                self._executor,
+                self.constraint_compiler.compile_spec,
+                sampling.constraint,
+            )
         self.core.submit(request)
 
         detok = IncrementalDetokenizer(self.tokenizer)
@@ -306,6 +327,7 @@ class Engine:
             "tpu": device_telemetry(),
             "prefix_cache": self.core.prefix_cache_info(),
             "kv_cache": self.core.kv_cache_info(),
+            "structured": self.core.structured_info(),
             "metrics": self.core.metrics.summary(),
         }
 

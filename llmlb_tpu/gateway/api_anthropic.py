@@ -41,6 +41,7 @@ from llmlb_tpu.gateway.model_names import to_canonical
 from llmlb_tpu.gateway.token_accounting import estimate_tokens
 from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
 from llmlb_tpu.gateway.types import Capability, TpsApiKind
+from llmlb_tpu.structured import inspect_request as inspect_structured
 
 ANTHROPIC_BASE = os.environ.get(
     "LLMLB_ANTHROPIC_BASE_URL", "https://api.anthropic.com"
@@ -383,6 +384,23 @@ async def messages(request: web.Request) -> web.StreamResponse:
     if trace is not None:
         trace.model = canonical
     openai_body = anthropic_request_to_openai(body)
+    # Forced tool_choice ({type: "tool"} → forced function call after the
+    # OpenAI conversion above) is grammar-constrained exactly like the
+    # OpenAI dialect: validate it here (400 in the Anthropic error shape,
+    # unsupported schema feature named) and steer to structured-capable
+    # endpoints when the model has any.
+    capability = Capability.CHAT_COMPLETION
+    try:
+        structured = inspect_structured(openai_body)
+    except ValueError as e:
+        state.metrics.record_structured_rejected()
+        return _anthropic_error(400, str(e))
+    if structured is not None:
+        state.metrics.record_structured_request(structured.kind)
+        if state.registry.find_by_model(
+            canonical, Capability.STRUCTURED_OUTPUTS
+        ):
+            capability = Capability.STRUCTURED_OUTPUTS
     prefix_hash = prefix_affinity_hash(
         canonical, affinity_text_from_body(body)
     )
@@ -397,15 +415,13 @@ async def messages(request: web.Request) -> web.StreamResponse:
     fo = FailoverController(
         state, canonical, trace=trace,
         candidates_fn=lambda: [
-            ep for ep, _ in state.registry.find_by_model(
-                canonical, Capability.CHAT_COMPLETION
-            )
+            ep for ep, _ in state.registry.find_by_model(canonical, capability)
         ],
     )
     while True:
         try:
             selection = await select_endpoint_with_queue(
-                state, canonical, Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
+                state, canonical, capability, TpsApiKind.CHAT,
                 trace=trace, prefix_hash=prefix_hash, exclude=fo.failed_ids,
                 queue_timeout_s=(fo.config.failover_queue_timeout_s
                                  if fo.failed_ids else None),
@@ -414,7 +430,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
             return _anthropic_error(
                 503, "all endpoints busy", "overloaded_error",
                 headers={"Retry-After": str(retry_after_seconds(
-                    state, canonical, Capability.CHAT_COMPLETION
+                    state, canonical, capability
                 ))},
             )
         if selection is None:

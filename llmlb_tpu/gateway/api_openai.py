@@ -39,6 +39,7 @@ from llmlb_tpu.gateway.token_accounting import (
 )
 from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
 from llmlb_tpu.gateway.types import Capability, Endpoint, TpsApiKind
+from llmlb_tpu.structured import inspect_request as inspect_structured
 
 log = logging.getLogger("llmlb_tpu.gateway.openai")
 
@@ -253,6 +254,28 @@ async def proxy_openai_post(
         prefix_affinity_hash(canonical, affinity_text_from_body(body))
         if capability == Capability.CHAT_COMPLETION else None
     )
+
+    # Structured outputs (chat dialect only — /v1/responses spells these
+    # fields differently and passes through untouched): validate
+    # response_format / tool_choice HERE so malformed shapes and unsupported
+    # JSON-Schema features 400 with the feature named instead of being
+    # proxied blind, and steer compilable requests to endpoints advertising
+    # the structured_outputs capability (tpu:// engines; an endpoint without
+    # it would silently ignore the constraint). Cloud-prefixed models never
+    # reach this point — they passed through above untouched.
+    if path == "/v1/chat/completions":
+        try:
+            structured = inspect_structured(body)
+        except ValueError as e:
+            state.metrics.record_structured_rejected()
+            return error_response(400, str(e))
+        if structured is not None:
+            state.metrics.record_structured_request(structured.kind)
+            if state.registry.find_by_model(
+                canonical, Capability.STRUCTURED_OUTPUTS
+            ):
+                capability = Capability.STRUCTURED_OUTPUTS
+
     client_ip = request.remote
     auth = request.get("auth")
     prompt_text = prompt_text_fn(body) if prompt_text_fn else ""

@@ -70,6 +70,11 @@ class GatewayMetrics:
         self._breaker_state: dict[str, int] = {}
         self._stream_interruptions: dict[tuple[str, str], int] = defaultdict(int)
         self._faults_injected: dict[str, int] = defaultdict(int)
+        # structured outputs (llmlb_tpu/structured): requests that asked for
+        # grammar-constrained decoding, by kind, and requests rejected 400
+        # at gateway-side validation (malformed / unsupported schema)
+        self._structured_requests: dict[str, int] = defaultdict(int)
+        self._structured_rejected = 0
 
     # ------------------------------------------------------------ recorders
 
@@ -133,6 +138,17 @@ class GatewayMetrics:
         with self._lock:
             self._faults_injected[kind] += 1
 
+    def record_structured_request(self, kind: str) -> None:
+        """One request asking for constrained decoding; `kind` is
+        json_object / json_schema / tool_call."""
+        with self._lock:
+            self._structured_requests[kind] += 1
+
+    def record_structured_rejected(self) -> None:
+        """Gateway-side validation refused a structured request (400)."""
+        with self._lock:
+            self._structured_rejected += 1
+
     def _observe(self, table: dict, buckets: tuple[float, ...],
                  model: str, endpoint: str, seconds: float) -> None:
         with self._lock:
@@ -183,6 +199,9 @@ class GatewayMetrics:
                 "stream_interruptions_total":
                     sum(self._stream_interruptions.values()),
                 "faults_injected_total": sum(self._faults_injected.values()),
+                "structured_requests_total":
+                    sum(self._structured_requests.values()),
+                "structured_rejected_total": self._structured_rejected,
                 "ttft_s": pcts(self._ttft),
                 "e2e_s": pcts(self._e2e),
                 "queue_wait_s": pcts(self._queue_wait),
@@ -271,6 +290,21 @@ class GatewayMetrics:
                     f'llmlb_gateway_faults_injected_total'
                     f'{{kind="{_escape(kind)}"}} {n}'
                 )
+            lines.append(
+                "# TYPE llmlb_gateway_structured_requests_total counter"
+            )
+            for kind, n in sorted(self._structured_requests.items()):
+                lines.append(
+                    f'llmlb_gateway_structured_requests_total'
+                    f'{{kind="{_escape(kind)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_structured_rejected_total counter"
+            )
+            lines.append(
+                f"llmlb_gateway_structured_rejected_total "
+                f"{self._structured_rejected}"
+            )
             for name, table in (
                 ("llmlb_gateway_ttft_seconds", self._ttft),
                 ("llmlb_gateway_e2e_seconds", self._e2e),

@@ -37,6 +37,10 @@ class Capability(str, enum.Enum):
     IMAGE_GENERATION = "image_generation"
     AUDIO_TRANSCRIPTION = "audio_transcription"
     AUDIO_SPEECH = "audio_speech"
+    # Grammar-constrained decoding (response_format json_schema / forced
+    # tool_choice). Advertised by tpu:// engines in /v1/models; the gateway
+    # steers constrained requests to endpoints that have it.
+    STRUCTURED_OUTPUTS = "structured_outputs"
 
 
 class Role(str, enum.Enum):

@@ -1,0 +1,55 @@
+"""Structured outputs: grammar-constrained decoding for the tpu:// engine.
+
+Pipeline (each stage its own module):
+
+    JSON Schema ──json_schema.py──▶ regex ──regex_dfa.py──▶ char DFA
+        ──constraint.py──▶ per-state token masks over the vocabulary
+        ──engine──▶ [B, V] additive logit bias, applied BEFORE the top-k
+                    sampling prefilter (ops/sampling.py)
+
+`openai_api.inspect_request` is the single notion of a valid structured
+request, shared by the gateway (early 400s) and the engine (actual
+constraint construction). See docs/structured-outputs.md.
+"""
+
+from llmlb_tpu.structured.constraint import (
+    MASK_NEG,
+    ConstraintCompiler,
+    ConstraintState,
+    TokenConstraint,
+    spec_hash,
+    spec_regex,
+)
+from llmlb_tpu.structured.json_schema import (
+    UnsupportedSchemaError,
+    any_object_regex,
+    schema_to_regex,
+)
+from llmlb_tpu.structured.openai_api import (
+    StructuredRequest,
+    inspect_request,
+    parse_seed,
+)
+from llmlb_tpu.structured.regex_dfa import (
+    CharDfa,
+    RegexSyntaxError,
+    compile_regex,
+)
+
+__all__ = [
+    "MASK_NEG",
+    "CharDfa",
+    "ConstraintCompiler",
+    "ConstraintState",
+    "RegexSyntaxError",
+    "StructuredRequest",
+    "TokenConstraint",
+    "UnsupportedSchemaError",
+    "any_object_regex",
+    "compile_regex",
+    "inspect_request",
+    "parse_seed",
+    "schema_to_regex",
+    "spec_hash",
+    "spec_regex",
+]

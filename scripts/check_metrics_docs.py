@@ -38,6 +38,9 @@ def engine_metric_names() -> set[str]:
             "enabled": True, "entries": 0, "pinned_slots": 0,
             "pinned_pages": 0, "pinned_hbm_bytes": 0,
         },
+        structured={
+            "enabled": True, "mask_cache_entries": 0, "mask_cache_bytes": 0,
+        },
         kv_cache={
             "layout": "paged", "page_size": 128, "pages_total": 0,
             "pages_free": 0, "pages_active": 0, "pages_pinned": 0,
@@ -67,6 +70,8 @@ def gateway_metric_names() -> set[str]:
     g.set_breaker_state("e", 2)
     g.record_stream_interruption("m", "e")
     g.record_fault_injected("connect_refused")
+    g.record_structured_request("json_schema")
+    g.record_structured_rejected()
     names = set(_TYPE_RE.findall(g.render()))
     # scrape-time gauges/counters injected by the /metrics handler
     app_src = (REPO / "llmlb_tpu" / "gateway" / "app.py").read_text()

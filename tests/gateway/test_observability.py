@@ -101,6 +101,7 @@ def test_engine_metrics_exposition_valid():
         "llmlb_engine_ttft_seconds", "llmlb_engine_itl_seconds",
         "llmlb_engine_prefill_step_seconds",
         "llmlb_engine_decode_step_seconds",
+        "llmlb_engine_schema_compile_seconds",
     }
     assert "llmlb_engine_batch_occupancy 5" in text
 

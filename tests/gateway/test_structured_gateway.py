@@ -1,0 +1,220 @@
+"""Gateway-level structured outputs: a live gateway + real tpu:// engine
+(CPU JAX). Covers the acceptance path end to end — `response_format:
+json_schema` streamed through /v1/chat/completions parses and validates
+with finish_reason "stop" at grammar acceptance; forced tool_choice works
+on both the OpenAI and Anthropic dialects; malformed/unsupported requests
+400 in each dialect's error shape; capability routing steers constrained
+traffic to structured-capable endpoints."""
+
+import asyncio
+import json
+
+import jsonschema
+import pytest
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.engine.server import create_engine_app
+from llmlb_tpu.engine.service import Engine
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.types import Capability
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "city": {"enum": ["sf", "nyc"]},
+        "celsius": {"type": "boolean"},
+    },
+    "required": ["city", "celsius"],
+}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = Engine.from_preset(
+        "debug-tiny", model_id="tpu-structured", num_slots=4,
+        slot_capacity=128, prefill_buckets=(16, 32, 64),
+    )
+    yield eng
+    eng.shutdown()
+
+
+def test_structured_outputs_through_gateway(engine):
+    async def run():
+        gw = await GatewayHarness.create()
+        engine_server = TestServer(create_engine_app(engine,
+                                                     owns_engine=False))
+        await engine_server.start_server()
+        gw.state.health_checker = EndpointHealthChecker(
+            gw.state.registry, gw.state.load_manager, gw.state.db,
+            gw.state.http, gw.state.events, interval_s=3600, timeout_s=5.0,
+        )
+        try:
+            headers = await gw.admin_headers()
+            r = await gw.client.post("/api/endpoints", json={
+                "base_url": f"http://127.0.0.1:{engine_server.port}",
+                "name": "tpu0"}, headers=headers)
+            assert r.status == 201, await r.text()
+            ep_id = (await r.json())["id"]
+
+            # model sync picked up the structured_outputs capability advert
+            caps = {
+                c for m in gw.state.registry.models_for(ep_id)
+                for c in m.capabilities
+            }
+            assert Capability.STRUCTURED_OUTPUTS in caps, caps
+
+            iheaders = await gw.inference_headers()
+
+            # --- streamed json_schema through the gateway (acceptance) ---
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-structured", "max_tokens": 64,
+                "temperature": 1.0, "stream": True, "seed": 11,
+                "messages": [{"role": "user", "content": "weather json"}],
+                "response_format": {"type": "json_schema", "json_schema": {
+                    "name": "weather", "schema": SCHEMA}},
+            }, headers=iheaders)
+            assert r.status == 200, await r.text()
+            raw = (await r.read()).decode()
+            text, finish = "", None
+            for line in raw.splitlines():
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                chunk = json.loads(line[6:])
+                for choice in chunk.get("choices", []):
+                    delta = choice.get("delta", {})
+                    if delta.get("content"):
+                        text += delta["content"]
+                    if choice.get("finish_reason"):
+                        finish = choice["finish_reason"]
+            assert finish == "stop", raw[:400]
+            jsonschema.validate(json.loads(text), SCHEMA)
+
+            # --- forced tool call, OpenAI dialect, non-streamed ---
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-structured", "max_tokens": 64,
+                "temperature": 0.0,
+                "messages": [{"role": "user", "content": "call the tool"}],
+                "tools": [{"type": "function", "function": {
+                    "name": "get_weather", "parameters": SCHEMA}}],
+                "tool_choice": {"type": "function",
+                                "function": {"name": "get_weather"}},
+            }, headers=iheaders)
+            assert r.status == 200, await r.text()
+            choice = (await r.json())["choices"][0]
+            assert choice["finish_reason"] == "tool_calls"
+            call = choice["message"]["tool_calls"][0]
+            assert call["function"]["name"] == "get_weather"
+            jsonschema.validate(json.loads(call["function"]["arguments"]),
+                                SCHEMA)
+
+            # --- forced tool call, Anthropic dialect ---
+            r = await gw.client.post("/v1/messages", json={
+                "model": "tpu-structured", "max_tokens": 64,
+                "messages": [{"role": "user", "content": "call the tool"}],
+                "tools": [{"name": "get_weather",
+                           "input_schema": SCHEMA}],
+                "tool_choice": {"type": "tool", "name": "get_weather"},
+            }, headers=iheaders)
+            assert r.status == 200, await r.text()
+            msg = await r.json()
+            blocks = [b for b in msg["content"] if b["type"] == "tool_use"]
+            assert blocks and blocks[0]["name"] == "get_weather"
+            jsonschema.validate(blocks[0]["input"], SCHEMA)
+            assert msg["stop_reason"] == "tool_use"
+
+            # --- gateway-side validation: unsupported feature named, 400 ---
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-structured",
+                "messages": [{"role": "user", "content": "x"}],
+                "response_format": {"type": "json_schema", "json_schema": {
+                    "name": "bad",
+                    "schema": {"type": "object",
+                               "patternProperties": {"": {}}}}},
+            }, headers=iheaders)
+            assert r.status == 400
+            err = await r.json()
+            assert err["error"]["type"] == "invalid_request_error"
+            assert "patternProperties" in err["error"]["message"]
+
+            r = await gw.client.post("/v1/chat/completions", json={
+                "model": "tpu-structured",
+                "messages": [{"role": "user", "content": "x"}],
+                "response_format": {"type": "yaml"},
+            }, headers=iheaders)
+            assert r.status == 400
+            assert "yaml" in (await r.json())["error"]["message"]
+
+            # malformed tool_choice on the Anthropic dialect: its error shape
+            r = await gw.client.post("/v1/messages", json={
+                "model": "tpu-structured", "max_tokens": 16,
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": [{"name": "t",
+                           "input_schema": {"type": "object",
+                                            "allOf": []}}],
+                "tool_choice": {"type": "tool", "name": "t"},
+            }, headers=iheaders)
+            assert r.status == 400
+            body = await r.json()
+            assert body["type"] == "error"
+            assert "allOf" in body["error"]["message"]
+
+            # the engine never saw the rejected requests; gateway counters did
+            scrape = await (await gw.client.get("/metrics")).text()
+            assert "llmlb_gateway_structured_requests_total" in scrape
+            assert "llmlb_gateway_structured_rejected_total 3" in scrape
+        finally:
+            await engine_server.close()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_constrained_requests_steered_to_capable_endpoint(engine):
+    """Same model on two endpoints — one mock without structured_outputs,
+    the real engine with it. Constrained requests must always land on the
+    engine; the capability-blind mock must see none of them."""
+    async def run():
+        gw = await GatewayHarness.create()
+        engine_server = TestServer(create_engine_app(engine,
+                                                     owns_engine=False))
+        await engine_server.start_server()
+        mock = await MockOpenAIEndpoint(model="tpu-structured").start()
+        try:
+            gw.register_mock(mock.url, ["tpu-structured"], name="blind")
+            gw.register_mock(
+                f"http://127.0.0.1:{engine_server.port}",
+                ["tpu-structured"], name="tpu0",
+                capabilities=[Capability.CHAT_COMPLETION,
+                              Capability.STRUCTURED_OUTPUTS],
+            )
+            iheaders = await gw.inference_headers()
+            for i in range(4):
+                r = await gw.client.post("/v1/chat/completions", json={
+                    "model": "tpu-structured", "max_tokens": 48,
+                    "temperature": 0.0,
+                    "messages": [{"role": "user", "content": f"req {i}"}],
+                    "response_format": {"type": "json_schema",
+                                        "json_schema": {"name": "w",
+                                                        "schema": SCHEMA}},
+                }, headers=iheaders)
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                jsonschema.validate(
+                    json.loads(body["choices"][0]["message"]["content"]),
+                    SCHEMA,
+                )
+            assert not mock.requests_seen, (
+                "constrained request reached a non-structured endpoint"
+            )
+            # free-form traffic still spreads over both endpoints eventually
+            for i in range(8):
+                r = await gw.client.post("/v1/chat/completions", json={
+                    "model": "tpu-structured", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": f"free {i}"}],
+                }, headers=iheaders)
+                assert r.status == 200
+        finally:
+            await mock.stop()
+            await engine_server.close()
+            await gw.close()
+    asyncio.run(run())

@@ -1,0 +1,138 @@
+"""Sampling-parity tests for grammar masks and per-request seeds.
+
+The load-bearing property: the constraint mask is applied to the FULL logits
+BEFORE the TOPK_PREFILTER=64 top-k prefilter. An adversarial distribution
+whose allowed token set lies entirely outside the unconstrained top-64 must
+still sample only allowed ids — masking after the prefilter would leave the
+candidate window all -inf."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmlb_tpu.engine.tokenizer import ByteTokenizer
+from llmlb_tpu.ops.sampling import TOPK_PREFILTER, sample_tokens
+from llmlb_tpu.structured import ConstraintCompiler, ConstraintState
+
+VOCAB = 512
+
+
+def _adversarial_logits(allowed: np.ndarray) -> np.ndarray:
+    """[1, V] logits whose top-TOPK_PREFILTER ids are all DISALLOWED."""
+    rng = np.random.default_rng(0)
+    logits = rng.normal(0.0, 0.1, size=(1, VOCAB)).astype(np.float32)
+    blocked = np.nonzero(~allowed)[0]
+    assert len(blocked) >= TOPK_PREFILTER
+    logits[0, blocked[:TOPK_PREFILTER]] += 100.0  # decoys dominate
+    logits[0, allowed] -= 10.0  # allowed set buried far below the window
+    top = np.argsort(logits[0])[::-1][:TOPK_PREFILTER]
+    assert not allowed[top].any(), "construction failed: allowed id in top-64"
+    return logits
+
+
+@pytest.fixture(scope="module")
+def int_constraint():
+    compiler = ConstraintCompiler(ByteTokenizer(VOCAB), VOCAB)
+    return compiler.compile_spec({"type": "regex", "pattern": r"-?[0-9]+"})
+
+
+def test_mask_applied_before_topk_prefilter_greedy(int_constraint):
+    state = ConstraintState(int_constraint)
+    allowed = int_constraint.allowed[state.state]
+    logits = jnp.asarray(_adversarial_logits(allowed))
+    bias = jnp.asarray(state.bias_row())[None, :]
+    ids = sample_tokens(
+        logits, jax.random.PRNGKey(0),
+        jnp.zeros((1,)), jnp.ones((1,)), jnp.zeros((1,), jnp.int32),
+        bias,
+    )
+    assert allowed[int(ids[0])], int(ids[0])
+
+
+def test_mask_applied_before_topk_prefilter_stochastic(int_constraint):
+    state = ConstraintState(int_constraint)
+    allowed = int_constraint.allowed[state.state]
+    logits = jnp.asarray(_adversarial_logits(allowed))
+    bias = jnp.asarray(state.bias_row())[None, :]
+    for step in range(32):
+        ids = sample_tokens(
+            logits, jax.random.PRNGKey(step),
+            jnp.ones((1,)), jnp.ones((1,)) * 0.95,
+            jnp.zeros((1,), jnp.int32), bias,
+        )
+        assert allowed[int(ids[0])], int(ids[0])
+
+
+def test_mask_batch_mixes_constrained_and_free_rows(int_constraint):
+    """[B, V] mask: row 0 constrained, row 1 free — the free row must keep
+    the unconstrained argmax, bit for bit."""
+    state = ConstraintState(int_constraint)
+    allowed = int_constraint.allowed[state.state]
+    adversarial = _adversarial_logits(allowed)
+    logits = jnp.asarray(np.vstack([adversarial, adversarial]))
+    bias = jnp.asarray(np.vstack([
+        state.bias_row(), np.zeros((VOCAB,), np.float32)
+    ]))
+    temps = jnp.zeros((2,))
+    ids = sample_tokens(
+        logits, jax.random.PRNGKey(0), temps, jnp.ones((2,)),
+        jnp.zeros((2,), jnp.int32), bias,
+    )
+    assert allowed[int(ids[0])]
+    assert int(ids[1]) == int(jnp.argmax(logits[1]))
+
+
+def test_no_mask_no_seeds_is_bit_identical_to_legacy_signature():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, VOCAB)).astype(np.float32))
+    key = jax.random.PRNGKey(7)
+    temps = jnp.asarray([0.0, 0.7, 1.0, 1.3])
+    top_ps = jnp.asarray([1.0, 0.9, 0.95, 1.0])
+    top_ks = jnp.asarray([0, 5, 0, 40], jnp.int32)
+    legacy = sample_tokens(logits, key, temps, top_ps, top_ks)
+    # seeds=-1 rows must take the shared-key path unchanged
+    seeds = jnp.full((4,), -1, jnp.int32)
+    steps = jnp.asarray([3, 9, 2, 7], jnp.int32)
+    new = sample_tokens(logits, key, temps, top_ps, top_ks, None, seeds, steps)
+    np.testing.assert_array_equal(np.asarray(legacy), np.asarray(new))
+
+
+def test_seeded_rows_reproduce_independent_of_batch_and_key():
+    rng = np.random.default_rng(2)
+    row = rng.normal(size=(VOCAB,)).astype(np.float32)
+    temps1 = jnp.ones((1,))
+    ids_a = sample_tokens(
+        jnp.asarray(row[None, :]), jax.random.PRNGKey(0), temps1,
+        jnp.ones((1,)), jnp.zeros((1,), jnp.int32), None,
+        jnp.asarray([99], jnp.int32), jnp.asarray([5], jnp.int32),
+    )
+    # different shared key, different batch position, same (seed, step, row)
+    batch = np.vstack([rng.normal(size=(VOCAB,)).astype(np.float32), row])
+    ids_b = sample_tokens(
+        jnp.asarray(batch), jax.random.PRNGKey(1234), jnp.ones((2,)),
+        jnp.ones((2,)), jnp.zeros((2,), jnp.int32), None,
+        jnp.asarray([-1, 99], jnp.int32), jnp.asarray([0, 5], jnp.int32),
+    )
+    assert int(ids_a[0]) == int(ids_b[1])
+    # a different step must be able to move the sample over many draws
+    draws = {
+        int(sample_tokens(
+            jnp.asarray(row[None, :]), jax.random.PRNGKey(0), temps1,
+            jnp.ones((1,)), jnp.zeros((1,), jnp.int32), None,
+            jnp.asarray([99], jnp.int32), jnp.asarray([s], jnp.int32),
+        )[0])
+        for s in range(16)
+    }
+    assert len(draws) > 1
+
+
+def test_seeded_greedy_ignores_seed():
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(1, VOCAB)).astype(np.float32))
+    ids = sample_tokens(
+        logits, jax.random.PRNGKey(0), jnp.zeros((1,)), jnp.ones((1,)),
+        jnp.zeros((1,), jnp.int32), None,
+        jnp.asarray([5], jnp.int32), jnp.asarray([0], jnp.int32),
+    )
+    assert int(ids[0]) == int(jnp.argmax(logits[0]))

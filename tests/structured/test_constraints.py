@@ -1,0 +1,329 @@
+"""Unit tests for the structured-output subsystem (llmlb_tpu/structured):
+regex→DFA engine, JSON-Schema→regex compiler, token-level mask tables,
+ConstraintState advancement, and the LRU compile cache."""
+
+import json
+
+import jsonschema
+import numpy as np
+import pytest
+
+from llmlb_tpu.engine.tokenizer import ByteTokenizer
+from llmlb_tpu.structured import (
+    ConstraintCompiler,
+    ConstraintState,
+    RegexSyntaxError,
+    UnsupportedSchemaError,
+    any_object_regex,
+    compile_regex,
+    inspect_request,
+    parse_seed,
+    schema_to_regex,
+    spec_hash,
+)
+
+# ------------------------------------------------------------ regex engine
+
+
+@pytest.mark.parametrize("pattern,ok,bad", [
+    (r"-?(?:0|[1-9][0-9]*)", ["0", "-7", "123", "-100"], ["007", "-", "", "+1"]),
+    (r"(?:true|false)", ["true", "false"], ["tru", "truex", "TRUE"]),
+    (r"a{2,3}", ["aa", "aaa"], ["a", "aaaa"]),
+    (r"[a-c]+", ["a", "abc", "ccc"], ["", "d", "abd"]),
+    (r"[^x]*", ["", "ab", "yyy"], ["x", "ax"]),
+    (r"a(?:b|c)*d", ["ad", "abd", "abccbd"], ["a", "abc"]),
+    (r"\d{4}-\d{2}", ["2026-08"], ["2026-8", "20-08"]),
+    (r'"(?:[^"\\]|\\.)*"', ['""', '"hi"', '"a\\"b"'], ['"', '"a', 'a"']),
+], ids=["int", "bool", "braces", "class", "negclass", "group", "digits",
+        "string"])
+def test_regex_match(pattern, ok, bad):
+    dfa = compile_regex(pattern)
+    for text in ok:
+        assert dfa.walk(dfa.start, text) in dfa.accepting, text
+    for text in bad:
+        end = dfa.walk(dfa.start, text)
+        assert end is None or end not in dfa.accepting, text
+
+
+def test_regex_rejects_unsupported_syntax():
+    for pattern in ("(a", "a)", "[z-a]", "^abc$", r"\p{L}", "a{9999}",
+                    "*a", "a{2,1}"):
+        with pytest.raises(RegexSyntaxError):
+            compile_regex(pattern)
+
+
+def test_dead_states_pruned():
+    # every surviving state can still reach acceptance
+    dfa = compile_regex(r"ab|ac")
+    for state in range(dfa.num_states):
+        assert dfa.is_accepting(state) or dfa.trans[state]
+
+
+# ----------------------------------------------------- schema -> regex
+
+
+def _roundtrip(schema, text: str) -> bool:
+    dfa = compile_regex(schema_to_regex(schema))
+    end = dfa.walk(dfa.start, text)
+    return end is not None and end in dfa.accepting
+
+
+def test_schema_object_required_and_optional():
+    schema = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "boolean"},
+            "c": {"type": "string"},
+        },
+        "required": ["a"],
+    }
+    assert _roundtrip(schema, '{"a":1}')
+    assert _roundtrip(schema, '{"a":1,"b":true}')
+    assert _roundtrip(schema, '{"a":-2,"b":false,"c":"x"}')
+    assert _roundtrip(schema, '{"a":1,"c":""}')
+    assert not _roundtrip(schema, '{"b":true}')  # missing required
+    assert not _roundtrip(schema, '{"a":1,"d":2}')  # closed object
+    assert not _roundtrip(schema, '{"b":true,"a":1}')  # declaration order
+
+
+def test_schema_matches_only_valid_instances():
+    """Everything the grammar accepts must validate; a sample of invalid
+    instances must be rejected — the guarantee the bench asserts end-to-end."""
+    schema = {
+        "type": "object",
+        "properties": {
+            "kind": {"enum": ["add", "del"]},
+            "ids": {"type": "array", "items": {"type": "integer"},
+                    "minItems": 1, "maxItems": 3},
+            "note": {"type": ["string", "null"]},
+        },
+        "required": ["kind", "ids", "note"],
+    }
+    good = [
+        {"kind": "add", "ids": [1], "note": None},
+        {"kind": "del", "ids": [1, 2, 3], "note": "x"},
+    ]
+    for obj in good:
+        text = json.dumps(obj, separators=(",", ":"))
+        assert _roundtrip(schema, text), text
+        jsonschema.validate(obj, schema)
+    bad = [
+        {"kind": "mul", "ids": [1], "note": None},
+        {"kind": "add", "ids": [], "note": None},
+        {"kind": "add", "ids": [1, 2, 3, 4], "note": None},
+        {"kind": "add", "ids": [1], "note": 5},
+    ]
+    for obj in bad:
+        text = json.dumps(obj, separators=(",", ":"))
+        assert not _roundtrip(schema, text), text
+
+
+def test_schema_refs_const_anyof():
+    schema = {
+        "$defs": {"id": {"type": "integer"}},
+        "type": "object",
+        "properties": {
+            "v": {"const": 3},
+            "x": {"anyOf": [{"$ref": "#/$defs/id"}, {"type": "null"}]},
+        },
+        "required": ["v", "x"],
+    }
+    assert _roundtrip(schema, '{"v":3,"x":9}')
+    assert _roundtrip(schema, '{"v":3,"x":null}')
+    assert not _roundtrip(schema, '{"v":4,"x":9}')
+
+
+def test_schema_string_bounds_and_pattern():
+    assert _roundtrip({"type": "string", "minLength": 2, "maxLength": 3},
+                      '"ab"')
+    assert not _roundtrip({"type": "string", "minLength": 2}, '"a"')
+    assert _roundtrip({"type": "string", "pattern": "[a-z]{3}"}, '"abc"')
+    assert not _roundtrip({"type": "string", "pattern": "[a-z]{3}"}, '"ab1"')
+
+
+def test_json_object_mode_matches_any_object():
+    dfa = compile_regex(any_object_regex())
+    for text in ('{}', '{"a":1}', '{"a":{"b":[1,"x",null]},"c":true}'):
+        assert dfa.walk(dfa.start, text) in dfa.accepting, text
+    assert dfa.walk(dfa.start, '[1]') is None  # object, not array
+
+
+@pytest.mark.parametrize("schema,feature", [
+    ({"type": "object", "patternProperties": {"x": {}}}, "patternProperties"),
+    ({"$dynamicRef": "#x"}, "$dynamicRef"),
+    ({"allOf": [{"type": "string"}]}, "allOf"),
+    ({"type": "number", "minimum": 3}, "minimum"),
+    ({"type": "array", "uniqueItems": True}, "uniqueItems"),
+    ({"$ref": "#/$defs/n", "$defs": {"n": {"$ref": "#/$defs/n"}}},
+     "recursive $ref"),
+    ({"type": "object",
+      "properties": {c: {"type": "integer"} for c in "abcdefg"}},
+     "optional properties"),
+    ({"type": "string", "maxLength": 100000}, "maxLength"),
+    # a pattern able to emit a raw quote would break the JSON guarantee
+    ({"type": "string", "pattern": '[a-z"]+'}, "pattern"),
+    ({"type": "string", "pattern": "[^a]+"}, "pattern"),
+    # syntactically-broken patterns must fail at SCHEMA compile time (the
+    # gateway's validation pass), never after a stream is committed
+    ({"type": "string", "pattern": "(foo"}, "pattern"),
+], ids=["patternProps", "dynamicRef", "allOf", "minimum", "uniqueItems",
+        "recursiveRef", "tooManyOptional", "hugeMaxLength",
+        "patternQuote", "patternNegClass", "patternBadSyntax"])
+def test_unsupported_features_named_in_error(schema, feature):
+    with pytest.raises(UnsupportedSchemaError) as exc:
+        schema_to_regex(schema)
+    assert feature in str(exc.value)
+
+
+# ------------------------------------------------------- token constraints
+
+
+@pytest.fixture(scope="module")
+def compiler():
+    return ConstraintCompiler(ByteTokenizer(512), 512)
+
+
+def test_token_masks_follow_grammar(compiler):
+    tc = compiler.compile_spec({"type": "regex", "pattern": r"-?[0-9]+"})
+    state = ConstraintState(tc)
+    row = tc.allowed[state.state]
+    allowed = set(np.nonzero(row)[0].tolist())
+    assert allowed == {ord("-")} | set(range(ord("0"), ord("9") + 1))
+    assert state.advance(ord("-"))
+    # after "-" a digit is mandatory; EOS is not allowed (not accepting)
+    row = tc.allowed[state.state]
+    assert not row[compiler.eos_id]
+    assert state.advance(ord("4"))
+    assert state.is_accepting
+    assert tc.allowed[state.state][compiler.eos_id]
+    assert state.advance(compiler.eos_id)
+    assert not state.violated
+
+
+def test_constraint_violation_flag(compiler):
+    tc = compiler.compile_spec({"type": "regex", "pattern": "ab"})
+    state = ConstraintState(tc)
+    assert not state.advance(ord("x"))
+    assert state.violated
+    # EOS before acceptance is a violation too
+    state2 = ConstraintState(tc)
+    assert not state2.advance(compiler.eos_id)
+    assert state2.violated
+
+
+def test_greedy_mask_walk_terminates_with_valid_json(compiler):
+    schema = {"type": "object",
+              "properties": {"ok": {"type": "boolean"},
+                             "tag": {"enum": ["x", "y"]}},
+              "required": ["ok", "tag"]}
+    tc = compiler.compile_spec({"type": "json_schema", "schema": schema})
+    tok = ByteTokenizer(512)
+    state = ConstraintState(tc)
+    out = []
+    for _ in range(200):
+        ids = np.nonzero(tc.allowed[state.state])[0]
+        assert len(ids)
+        chosen = int(ids[0]) if int(ids[0]) != tok.eos_id else int(ids[-1])
+        if chosen == tok.eos_id:
+            assert state.is_accepting
+            break
+        assert state.advance(chosen)
+        out.append(chosen)
+    else:
+        pytest.fail("grammar never terminated")
+    jsonschema.validate(json.loads(tok.decode(out)), schema)
+
+
+def test_empty_decoding_tokens_never_allowed(compiler):
+    """Ids decoding to nothing (pad/bos and ids >= 258 on the byte
+    tokenizer) must be masked everywhere — they would stall the grammar."""
+    tc = compiler.compile_spec({"type": "json_object"})
+    dead = [257, 300, 511]
+    for state in range(tc.num_states):
+        assert not tc.allowed[state, dead].any()
+
+
+def test_lru_cache_hits_and_evictions():
+    comp = ConstraintCompiler(ByteTokenizer(512), 512, max_entries=2)
+    a = comp.compile_spec({"type": "regex", "pattern": "a"})
+    assert comp.compile_spec({"type": "regex", "pattern": "a"}) is a
+    assert comp.compile_cache_hits == 1 and comp.compile_cache_misses == 1
+    comp.compile_spec({"type": "regex", "pattern": "b"})
+    comp.compile_spec({"type": "regex", "pattern": "c"})  # evicts "a"
+    assert comp.evictions == 1
+    a2 = comp.compile_spec({"type": "regex", "pattern": "a"})  # recompiled
+    assert a2 is not a
+    info = comp.info()
+    assert info["mask_cache_entries"] == 2
+    assert info["mask_cache_bytes"] > 0
+    assert info["compile_cache_hit_rate"] is not None
+
+
+def test_spec_hash_is_stable_and_order_independent():
+    s1 = {"type": "json_schema", "schema": {"a": 1, "b": 2}}
+    s2 = {"schema": {"b": 2, "a": 1}, "type": "json_schema"}
+    assert spec_hash(s1) == spec_hash(s2)
+    assert spec_hash(s1) != spec_hash({"type": "json_object"})
+
+
+# -------------------------------------------------- OpenAI request parsing
+
+
+def test_inspect_request_kinds():
+    assert inspect_request({"messages": []}) is None
+    assert inspect_request({"response_format": {"type": "text"}}) is None
+    r = inspect_request({"response_format": {"type": "json_object"}})
+    assert r.kind == "json_object"
+    schema = {"type": "object", "properties": {}, "required": []}
+    r = inspect_request({"response_format": {
+        "type": "json_schema", "json_schema": {"name": "t", "schema": schema}
+    }})
+    assert r.kind == "json_schema" and r.spec["schema"] == schema
+    tools = [{"type": "function",
+              "function": {"name": "f", "parameters": schema}}]
+    r = inspect_request({
+        "tools": tools,
+        "tool_choice": {"type": "function", "function": {"name": "f"}},
+    })
+    assert r.kind == "tool_call" and r.tool_name == "f"
+    r = inspect_request({"tools": tools, "tool_choice": "required"})
+    assert r.kind == "tool_call"
+    # auto/none and required-with-many-tools pass through unconstrained
+    assert inspect_request({"tools": tools, "tool_choice": "auto"}) is None
+    assert inspect_request(
+        {"tools": tools * 2, "tool_choice": "required"}
+    ) is None
+
+
+def test_inspect_request_rejections():
+    with pytest.raises(ValueError):
+        inspect_request({"response_format": {"type": "bogus"}})
+    with pytest.raises(ValueError):
+        inspect_request({"response_format": {"type": "json_schema"}})
+    with pytest.raises(ValueError):
+        inspect_request({"tool_choice": {"type": "function",
+                                         "function": {"name": "missing"}}})
+    with pytest.raises(UnsupportedSchemaError):
+        inspect_request({"response_format": {
+            "type": "json_schema",
+            "json_schema": {"name": "x",
+                            "schema": {"type": "object",
+                                       "patternProperties": {}}},
+        }})
+    with pytest.raises(ValueError):
+        inspect_request({
+            "response_format": {"type": "json_object"},
+            "tools": [{"type": "function", "function": {"name": "f"}}],
+            "tool_choice": {"type": "function", "function": {"name": "f"}},
+        })
+
+
+def test_parse_seed():
+    assert parse_seed({}) is None
+    assert parse_seed({"seed": 42}) == 42
+    assert parse_seed({"seed": -1}) >= 0  # folded into uint31 space
+    with pytest.raises(ValueError):
+        parse_seed({"seed": "42"})
+    with pytest.raises(ValueError):
+        parse_seed({"seed": True})
