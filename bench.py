@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -28,41 +27,8 @@ import time
 # tok/s per accelerator for 1B models; we take the high end as the bar).
 A100_CLASS_TOKS_PER_SEC = 3000.0
 
-# bf16 peak FLOPs by TPU generation (for the MFU estimate).
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5lite": 197e12,  # device_kind "TPU v5 lite" == v5e
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
 PROBE_TIMEOUT_S = 150
 PROBE_LONG_TIMEOUT_S = 420  # init over a tunnel can legitimately take minutes
-
-# The staged probe runs in a child with faulthandler stack dumps every 30s, so
-# a hang reports WHERE it hangs (e.g. jaxlib make_c_api_client waiting on the
-# PJRT plugin's device claim) instead of just "timed out".
-_PROBE_CODE = r"""
-import faulthandler, sys, time
-faulthandler.enable()
-faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
-t0 = time.time()
-def mark(stage):
-    print(f"[probe +{time.time()-t0:.1f}s] {stage}", file=sys.stderr, flush=True)
-mark("stage1: import jax")
-import jax
-mark(f"stage1 done: jax {jax.__version__}")
-mark("stage2: jax.devices() (backend init)")
-d = jax.devices()
-mark(f"stage2 done: {len(d)}x {getattr(d[0], 'device_kind', '?')}")
-mark("stage3: tiny matmul")
-import jax.numpy as jnp
-x = jnp.ones((256, 256), jnp.bfloat16)
-(x @ x).block_until_ready()
-mark("stage3 done")
-print(jax.default_backend(), len(d), getattr(d[0], 'device_kind', '?'))
-"""
 
 
 def log(msg: str) -> None:
@@ -76,24 +42,17 @@ def tpu_possibly_present() -> bool:
     timeout budget per attempt inside libtpu's make_c_api_client retry loop
     (BENCH_r05 spent 30 s+ per attempt doing exactly that), so the bench
     harness must decide "no TPU here" from the host alone and pin
-    JAX_PLATFORMS=cpu before the first device touch. Evidence accepted:
-    local accelerator device nodes, the TPU-VM metadata env vars, or an
-    explicit operator override (LLMLB_BENCH_FORCE_TPU_PROBE=1 — e.g. a
-    remote TPU behind a tunnel that leaves no local trace)."""
+    JAX_PLATFORMS=cpu before the first device touch. The evidence policy
+    (device nodes, TPU-VM metadata env vars, pinned JAX_PLATFORMS) is
+    SHARED with the engine server's init guard — tpu_probe.tpu_expected,
+    one policy for both callers; the bench adds only the explicit operator
+    override (LLMLB_BENCH_FORCE_TPU_PROBE=1 — e.g. a remote TPU behind a
+    tunnel that leaves no local trace)."""
     if os.environ.get("LLMLB_BENCH_FORCE_TPU_PROBE"):
         return True
-    env_platform = os.environ.get("JAX_PLATFORMS", "")
-    if "tpu" in env_platform.lower():
-        return True  # operator pinned TPU explicitly: probe it
-    if env_platform and "tpu" not in env_platform.lower():
-        return False  # operator pinned cpu/gpu: never probe
-    for name in ("TPU_NAME", "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
-                 "COLAB_TPU_ADDR", "TPU_ACCELERATOR_TYPE"):
-        if os.environ.get(name):
-            return True
-    import glob
+    from llmlb_tpu.engine.tpu_probe import tpu_expected
 
-    return bool(glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"))
+    return tpu_expected()
 
 
 def force_cpu_platform(reason: str) -> None:
@@ -106,67 +65,16 @@ def force_cpu_platform(reason: str) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _tail(text: str | bytes | None, lines: int = 25) -> list[str]:
-    if not text:
-        return []
-    if isinstance(text, bytes):
-        text = text.decode("utf-8", "replace")
-    return [ln[:300] for ln in text.strip().splitlines()[-lines:]]
-
-
 def probe_tpu() -> tuple[bool, str, dict]:
     """Check TPU backend health in a subprocess so a hung init can't wedge the
-    bench. Staged (import → device enum → matmul) with periodic stack dumps;
-    on timeout the child's captured stderr is preserved as evidence. Two short
-    attempts, then one long one. Returns (ok, diagnostic, evidence)."""
-    env = dict(os.environ)
-    # Verbose init logging from libtpu/PJRT so a hang leaves a trail.
-    env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
-    env.setdefault("TPU_MIN_LOG_LEVEL", "0")
-    env.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
+    bench. The staged probe itself (import → device enum → matmul, periodic
+    faulthandler stack dumps, captured child stderr as evidence) is shared
+    with the engine server's startup guard — llmlb_tpu/engine/tpu_probe.py.
+    One short attempt, then one long one (init over a tunnel can take
+    minutes). Returns (ok, diagnostic, evidence)."""
+    from llmlb_tpu.engine.tpu_probe import staged_probe
 
-    evidence: dict = {"attempts": []}
-    last = ""
-    # One short attempt, then one long one — init over a tunnel can take
-    # minutes, and every hang leaves staged stack evidence either way.
-    timeouts = [PROBE_TIMEOUT_S, PROBE_LONG_TIMEOUT_S]
-    for attempt, timeout_s in enumerate(timeouts, start=1):
-        log(f"TPU probe attempt {attempt}/{len(timeouts)} (timeout {timeout_s}s)")
-        rec: dict = {"attempt": attempt, "timeout_s": timeout_s}
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_CODE],
-                capture_output=True, text=True, timeout=timeout_s, env=env,
-            )
-        except subprocess.TimeoutExpired as te:
-            # TimeoutExpired carries the child's output so far — keep it.
-            rec["outcome"] = f"timeout after {timeout_s}s"
-            rec["child_stderr_tail"] = _tail(te.stderr)
-            rec["child_stdout_tail"] = _tail(te.stdout)
-            evidence["attempts"].append(rec)
-            last = f"probe timed out after {timeout_s}s (backend init hang)"
-            log(last)
-            for ln in rec["child_stderr_tail"]:
-                log(f"  child| {ln}")
-            continue
-        rec["returncode"] = r.returncode
-        if r.returncode == 0 and r.stdout.strip():
-            out = r.stdout.strip().splitlines()[-1]
-            log(f"TPU probe OK: {out}")
-            rec["outcome"] = f"ok: {out}"
-            evidence["attempts"].append(rec)
-            if out.startswith(("tpu", "axon")):
-                return True, out, evidence
-            last = f"backend is {out!r}, not tpu"
-            return False, last, evidence
-        rec["outcome"] = f"rc={r.returncode}"
-        rec["child_stderr_tail"] = _tail(r.stderr)
-        rec["child_stdout_tail"] = _tail(r.stdout)
-        evidence["attempts"].append(rec)
-        tail = rec["child_stderr_tail"] or rec["child_stdout_tail"] or ["unknown"]
-        last = f"probe rc={r.returncode}: {tail[-1]}"
-        log(last)
-    return False, last, evidence
+    return staged_probe((PROBE_TIMEOUT_S, PROBE_LONG_TIMEOUT_S), log_fn=log)
 
 
 def run_engine_bench(platform: str) -> dict:
@@ -291,11 +199,18 @@ def run_engine_bench(platform: str) -> dict:
 
     per_chip = toks_per_sec / max(n_chips, 1)
 
-    # MFU: decode FLOPs/token ~= 2 * params. Count params from the pytree.
+    # MFU: decode FLOPs/token ~= 2 * params, against the shared peak-spec
+    # table (engine/telemetry.py CHIP_SPECS — the same figures the engine's
+    # live llmlb_engine_mfu_ratio gauge divides by).
+    from llmlb_tpu.engine.telemetry import chip_spec_for, model_flops_per_token
+
     n_params = sum(int(np.prod(v.shape)) for v in core.params.values())
-    peak = next((f for k, f in _PEAK_FLOPS.items()
-                 if k in str(kind).lower().replace(" ", "")), None)
-    mfu = (2.0 * n_params * per_chip / peak) if (peak and on_tpu) else None
+    spec = chip_spec_for(kind)
+    mfu = (model_flops_per_token(cfg, n_params) * per_chip / spec.peak_flops
+           if (spec and on_tpu) else None)
+    # the engine's own live figure over its recent decode window — should
+    # track the bench's steady-state estimate on TPU
+    engine_perf = core.perf_info()
 
     kernels = "pallas" if (on_tpu and n_chips == 1 and os.environ.get(
         "LLMLB_TPU_ATTENTION", "auto") != "xla") else "xla"
@@ -321,6 +236,10 @@ def run_engine_bench(platform: str) -> dict:
             round(long_ttft_ms, 1) if long_ttft_ms is not None else None
         ),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "engine_mfu_live": engine_perf.get("mfu"),
+        "engine_hbm_bw_utilization_live": engine_perf.get(
+            "hbm_bw_utilization"
+        ),
         "attention_kernels": kernels,
         "through_engine_core": True,
     }

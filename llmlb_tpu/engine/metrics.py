@@ -25,6 +25,11 @@ STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 # seconds for 128k-token vocabularies (docs/structured-outputs.md sizing).
 COMPILE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
                    30.0)
+# Step-phase breakdown (engine/stepstats.py taxonomy): host-side phases
+# (plan/sync/dispatch/fetch/emit) are tens of µs to low ms; compute spans
+# µs (CPU debug configs) to hundreds of ms (chunked prefill on TPU).
+PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 
 
 class Histogram:
@@ -108,6 +113,15 @@ class EngineMetrics:
         self.mask_cache_misses_total = 0
         self.mask_cache_evictions_total = 0
         self.schema_compile = Histogram(COMPILE_BUCKETS)
+        # Step-phase time breakdown (engine/stepstats.py): one histogram per
+        # phase of the step loop, fed once per dispatch, plus the slow-step
+        # anomaly counter. Lazily keyed so only phases that occur render.
+        from llmlb_tpu.engine.stepstats import PHASES
+
+        self.step_phase: dict[str, Histogram] = {
+            p: Histogram(PHASE_BUCKETS) for p in PHASES
+        }
+        self.slow_steps_total = 0
 
     # ------------------------------------------------------------ recorders
 
@@ -194,6 +208,19 @@ class EngineMetrics:
         with self._lock:
             self.mask_cache_evictions_total += 1
 
+    def record_step_phases(self, phases: dict[str, float],
+                           slow: bool = False) -> None:
+        """One locked update per step: every phase duration plus the
+        anomaly flag. Skipping zero-duration phases keeps absent phases
+        (e.g. fetch on a prefill record) out of the histograms."""
+        with self._lock:
+            for name, seconds in phases.items():
+                hist = self.step_phase.get(name)
+                if hist is not None and seconds > 0.0:
+                    hist.observe(seconds)
+            if slow:
+                self.slow_steps_total += 1
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -230,13 +257,16 @@ class EngineMetrics:
     def render(self, *, queue_depth: int, active_slots: int,
                num_slots: int, prefix_cache: dict | None = None,
                kv_cache: dict | None = None,
-               structured: dict | None = None) -> str:
+               structured: dict | None = None,
+               perf: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
         kv_cache_info() block — page-pool gauges render when the paged
         layout is active; `structured` is the constraint compiler's info()
-        block (mask-cache size gauges)."""
+        block (mask-cache size gauges); `perf` is its perf_info() block —
+        MFU / HBM-bandwidth gauges render when the chip is in the peak-spec
+        table and decode traffic has flowed."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -290,7 +320,23 @@ class EngineMetrics:
                 "# TYPE llmlb_engine_mask_cache_evictions_total counter",
                 "llmlb_engine_mask_cache_evictions_total "
                 f"{self.mask_cache_evictions_total}",
+                "# TYPE llmlb_engine_slow_steps_total counter",
+                f"llmlb_engine_slow_steps_total {self.slow_steps_total}",
             ]
+            if perf is not None and perf.get("available"):
+                lines += [
+                    "# TYPE llmlb_engine_mfu_ratio gauge",
+                    f"llmlb_engine_mfu_ratio {perf['mfu']}",
+                    "# TYPE llmlb_engine_hbm_bw_utilization_ratio gauge",
+                    "llmlb_engine_hbm_bw_utilization_ratio "
+                    f"{perf['hbm_bw_utilization']}",
+                    "# TYPE llmlb_engine_model_flops_per_token gauge",
+                    "llmlb_engine_model_flops_per_token "
+                    f"{perf['flops_per_token']}",
+                    "# TYPE llmlb_engine_model_bytes_per_token gauge",
+                    "llmlb_engine_model_bytes_per_token "
+                    f"{perf['bytes_per_token']}",
+                ]
             if structured is not None and structured.get("enabled"):
                 lines += [
                     "# TYPE llmlb_engine_mask_cache_entries gauge",
@@ -358,4 +404,21 @@ class EngineMetrics:
                 lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
                 lines.append(f"{name}_sum {hist.total}")
                 lines.append(f"{name}_count {hist.n}")
+            # per-phase step breakdown: one histogram family labeled by
+            # phase (engine/stepstats.py taxonomy); empty phases still
+            # render so dashboards see a complete label set
+            name = "llmlb_engine_step_phase_seconds"
+            lines.append(f"# TYPE {name} histogram")
+            for phase, hist in self.step_phase.items():
+                label = f'phase="{phase}"'
+                cumulative = 0
+                for i, edge in enumerate(hist.edges):
+                    cumulative += hist.counts[i]
+                    lines.append(
+                        f'{name}_bucket{{{label},le="{edge}"}} {cumulative}'
+                    )
+                cumulative += hist.counts[-1]
+                lines.append(f'{name}_bucket{{{label},le="+Inf"}} {cumulative}')
+                lines.append(f"{name}_sum{{{label}}} {hist.total}")
+                lines.append(f"{name}_count{{{label}}} {hist.n}")
             return "\n".join(lines) + "\n"

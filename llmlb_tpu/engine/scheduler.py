@@ -44,6 +44,7 @@ import numpy as np
 from llmlb_tpu.engine.metrics import EngineMetrics
 from llmlb_tpu.engine.paging import PagePool
 from llmlb_tpu.engine.prefix_cache import PrefixCache, PrefixEntry
+from llmlb_tpu.engine.stepstats import StepRecorder
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
@@ -527,6 +528,17 @@ class EngineCore:
         # in that mode the loop thread is both producer and consumer.
         self.pending: queue.Queue[Request] = queue.Queue()
         self.metrics = EngineMetrics()
+        # Step introspection (engine/stepstats.py): per-step phase records,
+        # slow-step anomalies, and the sliding decode window live MFU math
+        # reads. Always on — the recorder is a few clock reads per step
+        # (< 1% of step time, guarded by test_step_introspection).
+        self.step_stats = StepRecorder()
+        # plan/insert time accrued since the last dispatched step; the next
+        # step record absorbs it (admission happens between dispatches)
+        self._pending_plan_s = 0.0
+        # static per-token cost base for perf_info(): parameter count of the
+        # served model (device arrays are cheap to .size)
+        self.n_params = sum(int(v.size) for v in self.params.values())
         self._running = False
         self._thread: threading.Thread | None = None
         self._started_at = time.monotonic()
@@ -834,6 +846,19 @@ class EngineCore:
             self.prefix_cache.clear()
         self._prefix_pinned_pages = 0
 
+    def _record_step(self, kind: str, phases: dict[str, float], *,
+                     active_slots: int = 0, tokens: int = 0) -> None:
+        """Finalize one step record: absorb plan/insert time accrued since
+        the previous dispatch, feed the ring buffer + anomaly detector, and
+        mirror the phase durations into the Prometheus histograms."""
+        if self._pending_plan_s > 0.0:
+            phases["plan"] = phases.get("plan", 0.0) + self._pending_plan_s
+            self._pending_plan_s = 0.0
+        slow = self.step_stats.observe(kind, phases,
+                                       active_slots=active_slots,
+                                       tokens=tokens)
+        self.metrics.record_step_phases(phases, slow=slow)
+
     # Same-bucket pending prompts prefill TOGETHER in one dispatch (padded to
     # a power-of-two group so the jit cache stays at log2 sizes). Bounded so
     # a deep backlog cannot starve decode for longer than one group's
@@ -953,6 +978,7 @@ class EngineCore:
         return kept
 
     def _try_insert(self) -> bool:
+        plan_start = time.perf_counter()
         free = self._free_slots()
         if (not free and self.page_pool is None
                 and self.prefix_cache is not None and len(self.prefix_cache)):
@@ -1066,8 +1092,16 @@ class EngineCore:
             batch.append((slot_id, request, n))
 
         if not batch:
+            if handled:
+                # admission work with no prefill dispatch of its own (cached
+                # inserts, long-prompt claims): the next step record absorbs
+                # it as its plan phase
+                self._pending_plan_s += time.perf_counter() - plan_start
             return handled
 
+        # plan ends where dispatch begins; the prefill records below absorb
+        # the accrued time via _record_step
+        self._pending_plan_s += time.perf_counter() - plan_start
         # one prefill dispatch per length bucket present in the batch
         by_bucket: dict[int, list[tuple[int, Request, int]]] = {}
         for entry in batch:
@@ -1382,6 +1416,64 @@ class EngineCore:
                                        self.kv_page_size),
         }
 
+    def perf_info(self) -> dict:
+        """Live roofline block for /api/system and /metrics: model-derived
+        static FLOPs/bytes per token divided by measured busy-time
+        throughput against the chip's peak specs (engine/telemetry.py
+        CHIP_SPECS, keyed off device_kind). `available` is False on chips
+        outside the table (CPU included) or before any decode traffic —
+        the gauges are then absent, never wrong."""
+        from llmlb_tpu.engine.telemetry import (
+            chip_spec_for,
+            model_bytes_per_token,
+            model_flops_per_token,
+        )
+
+        devices = jax.local_devices()
+        kind = (getattr(devices[0], "device_kind", "unknown")
+                if devices else "none")
+        n_chips = max(1, len(devices))
+        spec = chip_spec_for(kind)
+        busy_s, toks = self.step_stats.window_throughput()
+        tok_per_s = toks / busy_s if busy_s > 0 else 0.0
+        # mean live context + batch across active decode slots; the window
+        # figures already average over recent steps, so a point-in-time
+        # read of the live state is the matching granularity
+        contexts = [
+            int(self._seq_lens[i]) for i, s in enumerate(self.slots)
+            if s.request is not None and not s.prefilling
+        ]
+        mean_ctx = (sum(contexts) / len(contexts)) if contexts else 0.0
+        batch = max(1, len(contexts))
+        flops_tok = model_flops_per_token(self.cfg, self.n_params)
+        bytes_tok = model_bytes_per_token(self.cfg, self.n_params, mean_ctx,
+                                          batch=batch)
+        info = {
+            "device_kind": str(kind),
+            "n_chips": n_chips,
+            "n_params": self.n_params,
+            "flops_per_token": flops_tok,
+            "bytes_per_token": round(bytes_tok, 1),
+            "mean_context_tokens": round(mean_ctx, 1),
+            "window_tokens": toks,
+            "window_busy_s": round(busy_s, 4),
+            "tokens_per_sec_busy": round(tok_per_s, 2),
+            "available": spec is not None and tok_per_s > 0,
+        }
+        if spec is not None:
+            info["chip"] = {
+                "generation": spec.generation,
+                "peak_flops": spec.peak_flops,
+                "peak_hbm_bw": spec.peak_hbm_bw,
+            }
+        if info["available"]:
+            per_chip = tok_per_s / n_chips
+            info["mfu"] = round(flops_tok * per_chip / spec.peak_flops, 6)
+            info["hbm_bw_utilization"] = round(
+                bytes_tok * per_chip / spec.peak_hbm_bw, 6
+            )
+        return info
+
     def _prefill_group(self, bucket: int,
                        group: list[tuple[int, Request, int]]) -> None:
         """Prefill G same-bucket prompts in one dispatch, padded to the next
@@ -1403,6 +1495,7 @@ class EngineCore:
         slot_ids[g:] = slot_ids[g - 1]
 
         prefill_start = time.monotonic()
+        t_dispatch = time.perf_counter()
         if self.page_pool is not None:
             # padding rows repeat the last real slot's table row, so their
             # duplicate scatters rewrite identical cells (same trick as ids)
@@ -1427,11 +1520,19 @@ class EngineCore:
                 self.cache_v,
                 self.mesh,
             )
+        t_compute = time.perf_counter()
         # jitted prefill returns futures (async dispatch); block before timing
         # or the histogram records dispatch overhead, not device execution.
         jax.block_until_ready(logits)
+        t_done = time.perf_counter()
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
         self._activate_group(group, slot_ids, lens, logits)
+        self._record_step(
+            "prefill",
+            {"dispatch": t_compute - t_dispatch, "compute": t_done - t_compute,
+             "emit": time.perf_counter() - t_done},
+            active_slots=len(group), tokens=sum(n for _, _, n in group),
+        )
 
     def _activate_group(self, group: list[tuple[int, Request, int]],
                         padded_slot_ids: np.ndarray, padded_lens: np.ndarray,
@@ -1541,11 +1642,20 @@ class EngineCore:
         ids = np.zeros((1, padded), np.int32)
         ids[0, :n] = request.prompt_ids
         prefill_start = time.monotonic()
+        t_dispatch = time.perf_counter()
         logits, k_all, v_all = self._cp_prefill_fn(
             self.params, jnp.asarray(ids), jnp.asarray([n], np.int32)
         )
+        t_compute = time.perf_counter()
         jax.block_until_ready(logits)  # async dispatch; time real execution
+        t_done = time.perf_counter()
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
+        self._record_step(
+            "prefill",
+            {"dispatch": t_compute - t_dispatch,
+             "compute": t_done - t_compute},
+            active_slots=1, tokens=n,
+        )
         # KV beyond n is padding garbage; it lands in cells past the valid
         # length (masked by decode attention and overwritten as the sequence
         # grows into them) — same contract as the chunked path.
@@ -1598,6 +1708,7 @@ class EngineCore:
         ids[0, :chunk_len] = request.prompt_ids[start:start + chunk_len]
 
         prefill_start = time.monotonic()
+        t_dispatch = time.perf_counter()
         if self.page_pool is not None:
             logits, self.cache_k, self.cache_v = self.family.prefill_extend_pages(
                 self.params,
@@ -1622,7 +1733,9 @@ class EngineCore:
                 self.cache_v,
                 self.mesh,
             )
+        t_compute = time.perf_counter()
         jax.block_until_ready(logits)  # async dispatch; time real execution
+        t_done = time.perf_counter()
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
 
         slot.prefill_pos = start + chunk_len
@@ -1630,6 +1743,12 @@ class EngineCore:
             slot.prefilling = False
             self._release_cache_entry(slot)  # suffix landed; donor evictable
             self._activate_slot(slot_id, request, n, logits)
+        self._record_step(
+            "prefill",
+            {"dispatch": t_compute - t_dispatch, "compute": t_done - t_compute,
+             "emit": time.perf_counter() - t_done},
+            active_slots=1, tokens=chunk_len,
+        )
         return True
 
     def _activate_slot(self, slot_id: int, request: Request, n: int,
@@ -1732,6 +1851,7 @@ class EngineCore:
             self.metrics.set_batch_occupancy(0)
             return False
 
+        t_sync = time.perf_counter()
         if self.page_pool is not None:
             # alloc-on-extend: every page this dispatch writes must exist
             # before the tables ship to the device
@@ -1740,6 +1860,7 @@ class EngineCore:
                 self.metrics.set_batch_occupancy(0)
                 return True  # pool exhaustion finished requests: work done
             self._sync_block_tables()
+        sync_s = time.perf_counter() - t_sync
 
         self._key, sk = jax.random.split(self._key)
         k = self.decode_burst
@@ -1757,6 +1878,7 @@ class EngineCore:
         if k > 1:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
+            t_dispatch = time.perf_counter()
             if self.page_pool is not None:
                 (self._d_last_tokens, self._d_seq_lens, self.cache_k,
                  self.cache_v, toks_dev) = self._decode_many_for(window)(
@@ -1773,17 +1895,34 @@ class EngineCore:
                     self._d_temps, self._d_top_ps, self._d_top_ks,
                     self._d_seeds, sk,
                 )
+            t_compute = time.perf_counter()
+            # split device execution from the D2H readback: the dispatch
+            # returned futures, block_until_ready is the compute wait, the
+            # fetch below is pure transfer
+            jax.block_until_ready(toks_dev)
+            t_fetch = time.perf_counter()
             tokens = self._fetch_tokens(toks_dev)  # ONE D2H sync per k tokens
+            t_emit = time.perf_counter()
             # Tokens reach the host back-to-back, so wall-clock gaps between
             # _emit calls are ~0 and would poison the ITL histogram; record
             # the amortized per-token pacing of the burst instead.
             step_s = (time.monotonic() - burst_start) / k
             self.metrics.record_decode_step(step_s, len(active))
             self._emit_fetched(tokens, active, itl=step_s)
+            self._record_step(
+                "decode",
+                {"host_sync": sync_s,
+                 "dispatch": t_compute - t_dispatch,
+                 "compute": t_fetch - t_compute,
+                 "fetch": t_emit - t_fetch,
+                 "emit": time.perf_counter() - t_emit},
+                active_slots=len(active), tokens=k * len(active),
+            )
             return True
 
         step_start = time.monotonic()
         first_in = self._d_last_tokens  # pre-step tokens: pending firsts
+        t_dispatch = time.perf_counter()
         if self.page_pool is not None:
             logits, self.cache_k, self.cache_v = self.family.decode_step_paged(
                 self.params,
@@ -1807,23 +1946,41 @@ class EngineCore:
                 self.mesh,
                 window=self._window_for(active, 1),
             )
+        dispatch_s = time.perf_counter() - t_dispatch
+        t_mask = time.perf_counter()
         mask = self._sync_mask() if constrained_active else None
+        sync_s += time.perf_counter() - t_mask
         if mask is not None:
             self.metrics.record_masked_decode_step()
+        t_sample = time.perf_counter()
         tokens_dev = sample_tokens(
             logits, sk, self._d_temps, self._d_top_ps, self._d_top_ks,
             mask, self._d_seeds, self._d_seq_lens,
         )
         self._d_last_tokens = tokens_dev
         self._d_seq_lens = self._d_seq_lens + 1
+        dispatch_s += time.perf_counter() - t_sample
+        t_compute = time.perf_counter()
+        jax.block_until_ready(tokens_dev)  # device execution, not transfer
+        t_fetch = time.perf_counter()
         # the one D2H sync per step; row 0 carries deferred first emissions.
         # itl = this step's duration: a deferred first and its decode token
         # land in the same fetch, so the wall gap between them is ~0 and
         # would skew the histogram exactly like an unamortized burst.
         tokens = self._fetch_tokens(jnp.stack([first_in, tokens_dev]))
+        t_emit = time.perf_counter()
         step_s = time.monotonic() - step_start
         self.metrics.record_decode_step(step_s, len(active))
         self._emit_fetched(tokens, active, itl=step_s)
+        self._record_step(
+            "decode",
+            {"host_sync": sync_s,
+             "dispatch": dispatch_s,
+             "compute": t_fetch - t_compute,
+             "fetch": t_emit - t_fetch,
+             "emit": time.perf_counter() - t_emit},
+            active_slots=len(active), tokens=len(active),
+        )
         return True
 
     def _emit_fetched(self, tokens, active: list[int],

@@ -22,6 +22,7 @@ import uuid
 from aiohttp import web
 
 from llmlb_tpu import __version__
+from llmlb_tpu.engine.profiling import ProfileError, ProfileManager
 from llmlb_tpu.engine.scheduler import SamplingParams
 from llmlb_tpu.engine.service import Engine, EngineError
 from llmlb_tpu.structured import inspect_request, parse_seed
@@ -117,7 +118,8 @@ class EngineAPI:
         self.asr = asr  # engine.asr.AsrEngine | None
         self.tts = tts  # engine.tts.TtsEngine | None
         self.image = image  # engine.image.ImageEngine | None
-        self._profiling = False  # one capture at a time (jax global tracer)
+        # one capture at a time: the manager guards the global jax tracer
+        self.profiles = ProfileManager()
 
     # ------------------------------------------------------------- inventory
 
@@ -275,6 +277,7 @@ class EngineAPI:
             queue_depth=stats.queued, active_slots=stats.active_slots,
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
             kv_cache=core.kv_cache_info(), structured=core.structured_info(),
+            perf=core.perf_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -292,20 +295,119 @@ class EngineAPI:
                 # the static slot-cache footprint
                 "kv_cache": self.engine.core.kv_cache_info(),
                 "structured": self.engine.core.structured_info(),
+                # live roofline: MFU / HBM-bandwidth utilization against the
+                # chip's peak specs (available only on chips in the table
+                # and once decode traffic has flowed)
+                "perf": self.engine.core.perf_info(),
             }
         )
 
-    async def debug_profile(self, request: web.Request) -> web.Response:
-        """POST /debug/profile {"seconds": N} — capture a jax.profiler device
-        trace of the live serving loop (XLA ops, Pallas kernels, transfers)
-        and return the trace directory for TensorBoard/xprof. The reference
-        has no profiler (SURVEY §5 'no flamegraph/pprof tooling'); on TPU
-        this is how an operator answers 'where do my step milliseconds go'."""
+    async def steps(self, request: web.Request) -> web.Response:
+        """GET /api/steps — the step-loop introspection surface: recent
+        per-step phase breakdowns (plan / host_sync / dispatch / compute /
+        fetch / emit), per-kind EMA baselines, and slow-step anomalies.
+        `?limit=N` bounds the record count (default 64, max ring size);
+        `?slow=1` returns only anomalous steps."""
+        core = self.engine.core
+        try:
+            limit = int(request.query.get("limit", 64))
+        except ValueError:
+            return _error(400, "'limit' must be an integer")
+        slow_only = request.query.get("slow", "") in ("1", "true", "yes")
+        body = core.step_stats.snapshot(limit=limit, slow_only=slow_only)
+        body["perf"] = core.perf_info()
+        return web.json_response(body)
+
+    # ------------------------------------------------------------- profiling
+
+    @staticmethod
+    def _profile_authorized(request: web.Request) -> bool:
+        """Capture gating: when LLMLB_PROFILE_TOKEN is set, profile control
+        and artifact download require `Authorization: Bearer <token>` — the
+        admin gate for a port that is otherwise auth-free by design."""
         import os
-        import tempfile
 
-        import jax
+        token = os.environ.get("LLMLB_PROFILE_TOKEN")
+        if not token:
+            return True
+        authz = request.headers.get("Authorization", "")
+        return authz == f"Bearer {token}"
 
+    async def profile_control(self, request: web.Request) -> web.Response:
+        """POST /api/profile — on-demand jax.profiler capture of the live
+        serving loop. Body: {"action": "start", "seconds": N} begins a
+        capture with a bounded auto-stop (max 60s); {"action": "stop"} ends
+        it early. The completed capture is downloadable as a zip at
+        GET /api/profile/{capture_id} (docs/profiling.md)."""
+        if not self._profile_authorized(request):
+            return _error(401, "profile capture requires the profile token",
+                          "authentication_error")
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        action = body.get("action", "start")
+        try:
+            if action == "start":
+                try:
+                    seconds = float(body.get("seconds", 3.0))
+                except (TypeError, ValueError):
+                    return _error(400, "'seconds' must be a number")
+                started = self.profiles.start(seconds)
+                return web.json_response({"started": True, **started})
+            if action == "stop":
+                # stop serializes the whole trace — worker thread, so the
+                # event loop (and every in-flight stream) stays responsive
+                loop = asyncio.get_running_loop()
+                done = await loop.run_in_executor(None, self.profiles.stop)
+                return web.json_response({"stopped": True, **done})
+        except ProfileError as e:
+            return _error(e.status, str(e),
+                          "server_error" if e.status >= 500
+                          else "invalid_request_error")
+        return _error(400, "'action' must be 'start' or 'stop'")
+
+    async def profile_status(self, request: web.Request) -> web.Response:
+        """GET /api/profile — capture state + completed-capture ledger."""
+        if not self._profile_authorized(request):
+            return _error(401, "profile status requires the profile token",
+                          "authentication_error")
+        return web.json_response(self.profiles.status())
+
+    async def profile_artifact(self, request: web.Request) -> web.StreamResponse:
+        """GET /api/profile/{capture_id} — the downloadable trace artifact:
+        a zip of the capture's trace directory, unpackable for
+        `tensorboard --logdir` / xprof. Built on disk in a worker thread
+        (TPU traces run to hundreds of MB) and streamed from the file."""
+        if not self._profile_authorized(request):
+            return _error(401, "profile download requires the profile token",
+                          "authentication_error")
+        loop = asyncio.get_running_loop()
+        try:
+            path, filename = await loop.run_in_executor(
+                None, self.profiles.artifact,
+                request.match_info["capture_id"],
+            )
+        except ProfileError as e:
+            return _error(e.status, str(e))
+        return web.FileResponse(
+            path,
+            headers={"Content-Type": "application/zip",
+                     "Content-Disposition":
+                     f'attachment; filename="{filename}"'},
+        )
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """POST /debug/profile {"seconds": N} — the original one-shot form:
+        start a capture, wait out its bounded duration, return the trace
+        directory. Kept for operators and scripts that predate the
+        start/stop /api/profile surface; both share one ProfileManager, so
+        they can never double-start the global tracer."""
+        if not self._profile_authorized(request):
+            return _error(401, "profile capture requires the profile token",
+                          "authentication_error")
         try:
             body = await request.json() if request.can_read_body else {}
         except Exception:
@@ -316,49 +418,21 @@ class EngineAPI:
             seconds = min(30.0, max(0.1, float(body.get("seconds", 3.0))))
         except (TypeError, ValueError):
             return _error(400, "'seconds' must be a number")
-        if self._profiling:
-            return _error(409, "a profile capture is already running")
-        # Traces always land under a server-controlled root — the engine port
-        # is unauthenticated, so a client-supplied path would be an arbitrary
-        # directory-write primitive.
-        root = os.environ.get("LLMLB_TRACE_DIR") or tempfile.gettempdir()
-        os.makedirs(root, exist_ok=True)
-        out_dir = tempfile.mkdtemp(prefix="llmlb-trace-", dir=root)
-        # The whole capture is ONE uncancellable executor job: start, sleep,
-        # stop happen atomically on a worker thread, so a client disconnect
-        # (which cancels this handler) can neither leave the global tracer
-        # recording nor race a new start against an in-flight stop. The
-        # event loop (and every in-flight stream) stays responsive.
-        def _capture() -> None:
-            jax.profiler.start_trace(out_dir)
-            try:
-                time.sleep(seconds)
-            finally:
-                jax.profiler.stop_trace()
-
-        self._profiling = True
-        loop = asyncio.get_running_loop()
-        fut = loop.run_in_executor(None, _capture)
-
-        def _done(f) -> None:
-            # _profiling resets exactly when the capture actually ended —
-            # until then new requests correctly 409.
-            self._profiling = False
-            try:
-                f.result()
-            except Exception:
-                log.exception("profile capture failed")
-
-        fut.add_done_callback(_done)
         try:
-            await asyncio.shield(fut)
-        except asyncio.CancelledError:
-            raise  # client gone; the capture completes in the executor
-        except Exception as e:
-            return _error(500, f"profiler failed: {e}")
+            started = self.profiles.start(seconds)
+        except ProfileError as e:
+            return _error(e.status, str(e))
+        # the bounded auto-stop ends the capture even if the client leaves;
+        # this handler just waits for it so the response means "done"
+        deadline = time.monotonic() + seconds + 30.0
+        while time.monotonic() < deadline:
+            if not self.profiles.status()["recording"]:
+                break
+            await asyncio.sleep(0.05)
         return web.json_response({
-            "trace_dir": out_dir,
-            "seconds": seconds,
+            "trace_dir": started["trace_dir"],
+            "seconds": started["seconds"],
+            "capture_id": started["capture_id"],
             "hint": "tensorboard --logdir <trace_dir> (profile plugin)",
         })
 
@@ -768,6 +842,10 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     app.router.add_get("/api/health", api.health)
     app.router.add_get("/metrics", api.prometheus_metrics)
     app.router.add_get("/api/system", api.system)
+    app.router.add_get("/api/steps", api.steps)
+    app.router.add_post("/api/profile", api.profile_control)
+    app.router.add_get("/api/profile", api.profile_status)
+    app.router.add_get("/api/profile/{capture_id}", api.profile_artifact)
     app.router.add_post("/debug/profile", api.debug_profile)
 
     if owns_engine:
@@ -800,6 +878,14 @@ def main(argv: list[str] | None = None) -> None:
         "--decode-burst", type=int, default=None,
         help="decode+sample steps fused per device dispatch (default: "
              "8 on TPU, 1 elsewhere; also via LLMLB_DECODE_BURST)",
+    )
+    parser.add_argument(
+        "--init-timeout", type=float, default=None,
+        help="TPU backend-init guard: prove jax.devices() completes within "
+             "this many seconds in a probe child before serving; a hang "
+             "dumps the captured libtpu/PJRT log tail + faulthandler stacks "
+             "to stderr and exits instead of wedging silently (default 600; "
+             "0 disables; also via LLMLB_INIT_TIMEOUT)",
     )
     parser.add_argument(
         "--kv-layout", choices=("paged", "dense"), default=None,
@@ -874,6 +960,12 @@ def main(argv: list[str] | None = None) -> None:
         extra["min_prefix_len"] = max(1, args.min_prefix_len)
 
     logging.basicConfig(level=logging.INFO)
+    # TPU backend-init hang guard: BEFORE the first in-process jax backend
+    # touch (which construction below triggers), prove the backend comes up
+    # in a probe child or fail fast with the captured init-log evidence.
+    from llmlb_tpu.engine.tpu_probe import guard_backend_init
+
+    guard_backend_init(args.init_timeout)
     # Multi-host bring-up must precede the first jax backend use (engine
     # construction enumerates devices). No-op unless LLMLB_COORDINATOR/
     # LLMLB_NUM_HOSTS or LLMLB_DISTRIBUTED are set.

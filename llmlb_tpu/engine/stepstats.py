@@ -1,0 +1,160 @@
+"""Step-loop introspection: per-step phase records, ring buffer, anomalies.
+
+Answers "where does a step's time go?" for the engine's serving loop. Every
+scheduler step (prefill dispatch or decode dispatch) produces ONE StepRecord
+with a per-phase wall-clock breakdown:
+
+  plan      — request admission: queue pop, constraint prep, prefix match,
+              page reservation, slot claim (scheduler._try_insert)
+  host_sync — host→device state refresh before a dispatch: block-table rows
+              and grammar-mask rows changed since the last step
+  dispatch  — the jitted step call returning its (async) futures: python +
+              jax dispatch overhead, no device time
+  compute   — jax.block_until_ready delta: actual device execution
+  fetch     — device→host token readback (the per-step D2H sync)
+  emit      — host-side token delivery: stop checks, grammar FSM advance,
+              event-queue puts (detokenization itself runs on the service
+              layer's consumer threads, off the step loop)
+
+Records land in a bounded ring buffer served at the engine's ``/api/steps``
+plus per-phase histograms in ``/metrics``. A slow-step anomaly detector
+keeps an EMA of step time per kind and flags steps that exceed a
+configurable multiple of it — the "one step took 40x the usual" events that
+histograms average away.
+
+The recorder is deliberately dumb and allocation-light: a handful of
+``time.perf_counter()`` deltas per step and one dict append. The guarantee
+(tested in tests/engine/test_step_introspection.py) is < 1% of step time on
+the CPU debug engine, whose steps are orders of magnitude shorter than any
+real TPU step.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+PHASES = ("plan", "host_sync", "dispatch", "compute", "fetch", "emit")
+
+# EMA smoothing for the per-kind step-time baseline. Small alpha: the
+# baseline should drift with load, not chase a single outlier.
+_EMA_ALPHA = 0.05
+# A step is anomalous when it exceeds max(ratio x EMA, floor). The floor
+# keeps microsecond-scale CPU steps from flagging scheduler jitter.
+_SLOW_RATIO = 4.0
+_SLOW_FLOOR_S = 0.020
+# Steps observed before the detector arms (the first steps of a fresh
+# engine include XLA compiles and would all flag).
+_WARMUP_STEPS = 16
+
+
+class StepRecorder:
+    """Bounded ring of per-step phase breakdowns + slow-step detection +
+    a sliding window of (tokens, busy seconds) for live MFU math.
+
+    Thread-safety: observe() runs on the step loop only; snapshot()/window()
+    may run on scrape threads — everything mutable sits behind one lock
+    held for microseconds.
+    """
+
+    def __init__(self, capacity: int = 512, *, slow_ratio: float = _SLOW_RATIO,
+                 slow_floor_s: float = _SLOW_FLOOR_S,
+                 window: int = 128):
+        self.capacity = max(1, capacity)
+        self.slow_ratio = slow_ratio
+        self.slow_floor_s = slow_floor_s
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._ema: dict[str, float] = {}  # kind -> EMA of total_s
+        self._seen: dict[str, int] = {}
+        self.slow_steps_total = 0
+        # sliding window of decode steps for throughput-derived figures
+        self._window: deque[tuple[float, int]] = deque(maxlen=max(1, window))
+
+    # -------------------------------------------------------------- recording
+
+    def observe(self, kind: str, phases: dict[str, float], *,
+                active_slots: int = 0, tokens: int = 0) -> bool:
+        """Record one step; returns True when it was flagged anomalous.
+        `phases` maps phase name -> seconds (missing phases count as 0);
+        `tokens` is the number of tokens this step delivered to the host
+        (decode: burst x active slots)."""
+        now = time.time()
+        total = sum(phases.values())
+        with self._lock:
+            seen = self._seen.get(kind, 0)
+            ema = self._ema.get(kind)
+            slow = False
+            if seen >= _WARMUP_STEPS and ema is not None:
+                threshold = max(self.slow_ratio * ema, self.slow_floor_s)
+                slow = total > threshold
+                if slow:
+                    self.slow_steps_total += 1
+            # anomalous steps do not feed the baseline: one 40x step must
+            # not drag the EMA up and mask the next one
+            if ema is None:
+                self._ema[kind] = total
+            elif not slow:
+                self._ema[kind] = ema + _EMA_ALPHA * (total - ema)
+            self._seen[kind] = seen + 1
+            self._seq += 1
+            self._ring.append({
+                "seq": self._seq,
+                "ts": now,
+                "kind": kind,
+                "total_s": total,
+                "phases_s": {p: phases.get(p, 0.0) for p in PHASES},
+                "active_slots": active_slots,
+                "tokens": tokens,
+                "slow": slow,
+            })
+            if kind == "decode" and tokens > 0:
+                self._window.append((total, tokens))
+        return slow
+
+    # --------------------------------------------------------------- reading
+
+    def window_throughput(self) -> tuple[float, int]:
+        """(busy seconds, tokens) over the sliding decode window — the
+        denominator/numerator for live MFU. Busy seconds exclude idle loop
+        sleeps: MFU is measured against time the device was actually
+        stepping, which is the figure an operator tunes kernels by."""
+        with self._lock:
+            if not self._window:
+                return 0.0, 0
+            secs = sum(s for s, _ in self._window)
+            toks = sum(t for _, t in self._window)
+        return secs, toks
+
+    def snapshot(self, limit: int = 64, *, slow_only: bool = False) -> dict:
+        """JSON-safe view for /api/steps: recent records (newest first),
+        per-kind EMA baselines, and the anomaly counter."""
+        limit = max(0, min(limit, self.capacity))
+        with self._lock:
+            records = list(self._ring)
+            ema = dict(self._ema)
+            slow_total = self.slow_steps_total
+            seq = self._seq
+        if slow_only:
+            records = [r for r in records if r["slow"]]
+        records = records[-limit:]
+        records.reverse()
+        # copies: the ring's dicts stay untouched for concurrent snapshots
+        records = [
+            {**r,
+             "total_s": round(r["total_s"], 6),
+             "phases_s": {k: round(v, 6) for k, v in r["phases_s"].items()}}
+            for r in records
+        ]
+        return {
+            "steps_total": seq,
+            "buffered": len(self._ring) if not slow_only else None,
+            "capacity": self.capacity,
+            "slow_steps_total": slow_total,
+            "ema_step_s": {k: round(v, 6) for k, v in ema.items()},
+            "slow_ratio": self.slow_ratio,
+            "slow_floor_s": self.slow_floor_s,
+            "records": records,
+        }

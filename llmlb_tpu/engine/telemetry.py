@@ -8,9 +8,75 @@ The gateway's scheduler consumes these for placement decisions.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip peak figures for utilization math (bf16 dense FLOPs and HBM
+    bandwidth). Public spec-sheet numbers; MFU/HBM-utilization gauges divide
+    measured work by these."""
+
+    generation: str
+    peak_flops: float  # bf16 FLOP/s per chip
+    peak_hbm_bw: float  # bytes/s per chip
+
+
+# Keyed by a normalized device_kind substring (lowercase, spaces stripped).
+# jax reports e.g. "TPU v4", "TPU v5 lite", "TPU v5p", "TPU v6 lite".
+# Order matters: more specific keys first ("v5p" before "v5").
+CHIP_SPECS: tuple[tuple[str, ChipSpec], ...] = (
+    ("v6lite", ChipSpec("v6e", 918e12, 1.64e12)),
+    ("v6e", ChipSpec("v6e", 918e12, 1.64e12)),
+    ("v5p", ChipSpec("v5p", 459e12, 2.765e12)),
+    ("v5lite", ChipSpec("v5e", 197e12, 0.82e12)),
+    ("v5e", ChipSpec("v5e", 197e12, 0.82e12)),
+    ("v4", ChipSpec("v4", 275e12, 1.23e12)),
+)
+
+
+def chip_spec_for(device_kind: str) -> ChipSpec | None:
+    """Resolve a jax device_kind string to its peak specs (None for CPU /
+    unknown chips — utilization gauges are then unavailable, never wrong)."""
+    key = str(device_kind).lower().replace(" ", "")
+    for frag, spec in CHIP_SPECS:
+        if frag in key:
+            return spec
+    return None
+
+
+def model_flops_per_token(cfg, n_params: int) -> float:
+    """Decode FLOPs per generated token: ~2 FLOPs per parameter touched
+    (one multiply + one add per weight). MoE models only touch the routed
+    experts' FFN weights, so count active params, not total."""
+    experts = getattr(cfg, "num_experts", 0) or 0
+    if experts > 1:
+        per_tok = getattr(cfg, "experts_per_token", 1) or 1
+        # FFN weights are the expert-replicated part; attention/embed are
+        # shared. Approximate: scale the FFN fraction by routed/total.
+        ffn = (3 * cfg.hidden_size * cfg.intermediate_size
+               * cfg.num_layers * experts)
+        active = n_params - ffn + ffn * per_tok / experts
+        return 2.0 * active
+    return 2.0 * n_params
+
+
+def model_bytes_per_token(cfg, n_params: int, mean_context: float,
+                          batch: int = 1) -> float:
+    """HBM bytes read per decoded token: every weight once per STEP (decode
+    is memory-bound; weights dominate and are amortized across the `batch`
+    sequences decoded together) plus the KV rows of the sequence's own
+    context (never amortized — each sequence reads its own)."""
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    weight_bytes = n_params * itemsize / max(1, batch)
+    kv_bytes = (cfg.num_layers * mean_context * cfg.num_kv_heads
+                * cfg.head_dim_ * 2 * itemsize)
+    return weight_bytes + kv_bytes
 
 
 def device_telemetry() -> dict[str, Any]:

@@ -1,0 +1,186 @@
+"""Staged TPU backend probe: diagnose init hangs instead of suffering them.
+
+A broken libtpu / PJRT plugin / axon tunnel hangs `jax.devices()` forever
+with no output — BENCH_r03–r05 all timed out exactly there, which is why
+every committed bench number is still CPU (ROADMAP item 2). This module is
+the shared diagnosis plumbing:
+
+- The probe runs in a CHILD process, staged (import jax → device enum →
+  tiny matmul) with `faulthandler` stack dumps every 30s, so a hang reports
+  WHERE it hangs (e.g. jaxlib make_c_api_client waiting on the PJRT
+  plugin's device claim) and the captured libtpu/PJRT log tail survives the
+  kill.
+- `bench.py` uses it before committing to a TPU run (evidence lands in the
+  BENCH json `tail`); the ENGINE SERVER uses it at startup via
+  `guard_backend_init` — a configurable init timeout that dumps the child's
+  stderr tail to the server log and exits nonzero instead of wedging a
+  deployment silently.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+
+log = logging.getLogger("llmlb_tpu.engine.tpu_probe")
+
+PROBE_TIMEOUT_S = 150
+PROBE_LONG_TIMEOUT_S = 420  # init over a tunnel can legitimately take minutes
+
+# The staged probe runs in a child with faulthandler stack dumps every 30s, so
+# a hang reports WHERE it hangs instead of just "timed out".
+PROBE_CODE = r"""
+import faulthandler, sys, time
+faulthandler.enable()
+faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
+t0 = time.time()
+def mark(stage):
+    print(f"[probe +{time.time()-t0:.1f}s] {stage}", file=sys.stderr, flush=True)
+mark("stage1: import jax")
+import jax
+mark(f"stage1 done: jax {jax.__version__}")
+mark("stage2: jax.devices() (backend init)")
+d = jax.devices()
+mark(f"stage2 done: {len(d)}x {getattr(d[0], 'device_kind', '?')}")
+mark("stage3: tiny matmul")
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+mark("stage3 done")
+print(jax.default_backend(), len(d), getattr(d[0], 'device_kind', '?'))
+"""
+
+
+def tail(text: str | bytes | None, lines: int = 25) -> list[str]:
+    """Last N lines of captured child output, each clipped — the evidence
+    payload for BENCH json and startup failure logs."""
+    if not text:
+        return []
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    return [ln[:300] for ln in text.strip().splitlines()[-lines:]]
+
+
+def probe_env() -> dict:
+    """Child env with verbose libtpu/PJRT init logging, so a hang leaves a
+    trail in the captured stderr."""
+    env = dict(os.environ)
+    env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    env.setdefault("TPU_MIN_LOG_LEVEL", "0")
+    env.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
+    return env
+
+
+def staged_probe(
+    timeouts: tuple[float, ...] = (PROBE_TIMEOUT_S, PROBE_LONG_TIMEOUT_S),
+    *,
+    code: str | None = None,
+    log_fn=None,
+) -> tuple[bool, str, dict]:
+    """Run the staged probe subprocess once per timeout until it succeeds.
+    Returns (ok, diagnostic, evidence) — evidence carries per-attempt
+    outcome + child stdout/stderr tails (JSON-safe)."""
+    if code is None:
+        code = PROBE_CODE  # module attr at call time: tests may patch it
+    emit = log_fn or (lambda msg: log.info("%s", msg))
+    env = probe_env()
+    evidence: dict = {"attempts": []}
+    last = ""
+    for attempt, timeout_s in enumerate(timeouts, start=1):
+        emit(f"TPU probe attempt {attempt}/{len(timeouts)} "
+             f"(timeout {timeout_s}s)")
+        rec: dict = {"attempt": attempt, "timeout_s": timeout_s}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
+            )
+        except subprocess.TimeoutExpired as te:
+            # TimeoutExpired carries the child's output so far — keep it.
+            rec["outcome"] = f"timeout after {timeout_s}s"
+            rec["child_stderr_tail"] = tail(te.stderr)
+            rec["child_stdout_tail"] = tail(te.stdout)
+            evidence["attempts"].append(rec)
+            last = f"probe timed out after {timeout_s}s (backend init hang)"
+            emit(last)
+            for ln in rec["child_stderr_tail"]:
+                emit(f"  child| {ln}")
+            continue
+        rec["returncode"] = r.returncode
+        if r.returncode == 0 and r.stdout.strip():
+            out = r.stdout.strip().splitlines()[-1]
+            emit(f"TPU probe OK: {out}")
+            rec["outcome"] = f"ok: {out}"
+            evidence["attempts"].append(rec)
+            if out.startswith(("tpu", "axon")):
+                return True, out, evidence
+            last = f"backend is {out!r}, not tpu"
+            return False, last, evidence
+        rec["outcome"] = f"rc={r.returncode}"
+        rec["child_stderr_tail"] = tail(r.stderr)
+        rec["child_stdout_tail"] = tail(r.stdout)
+        evidence["attempts"].append(rec)
+        t = rec["child_stderr_tail"] or rec["child_stdout_tail"] or ["unknown"]
+        last = f"probe rc={r.returncode}: {t[-1]}"
+        emit(last)
+    return False, last, evidence
+
+
+def tpu_expected() -> bool:
+    """Host-side evidence that a TPU backend-init attempt is coming: the
+    operator pinned tpu, TPU-VM metadata is present, or accelerator device
+    nodes exist. Mirrors bench.py's detection (one policy, two callers)."""
+    env_platform = os.environ.get("JAX_PLATFORMS", "")
+    if "tpu" in env_platform.lower():
+        return True
+    if env_platform:  # operator pinned cpu/gpu: no TPU init will run
+        return False
+    for name in ("TPU_NAME", "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+                 "COLAB_TPU_ADDR", "TPU_ACCELERATOR_TYPE"):
+        if os.environ.get(name):
+            return True
+    import glob
+
+    return bool(glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*"))
+
+
+def guard_backend_init(timeout_s: float | None = None) -> None:
+    """Engine-server startup guard (ROADMAP item 2 prerequisite): before the
+    first in-process jax backend touch, prove the TPU backend initializes
+    within `timeout_s` in a CHILD — a hang there dumps the captured
+    libtpu/PJRT log tail + staged faulthandler stacks to stderr and raises
+    SystemExit, instead of the server wedging silently in jax.devices().
+
+    No-op when no TPU init is expected on this host (CPU deployments must
+    not pay a probe subprocess) or when disabled with timeout 0.
+    `timeout_s` defaults from LLMLB_INIT_TIMEOUT (seconds; default 600)."""
+    if timeout_s is None:
+        raw = os.environ.get("LLMLB_INIT_TIMEOUT", "")
+        try:
+            timeout_s = float(raw) if raw else 600.0
+        except ValueError:
+            log.warning("LLMLB_INIT_TIMEOUT=%r is not a number; using 600",
+                        raw)
+            timeout_s = 600.0
+    if timeout_s <= 0 or not tpu_expected():
+        return
+    ok, diag, evidence = staged_probe(
+        (timeout_s,), log_fn=lambda m: log.info("[init-probe] %s", m)
+    )
+    if ok:
+        return
+    print("=" * 72, file=sys.stderr)
+    print(f"TPU backend init FAILED: {diag}", file=sys.stderr)
+    for rec in evidence["attempts"]:
+        print(f"-- attempt {rec['attempt']} ({rec['outcome']}):",
+              file=sys.stderr)
+        for ln in rec.get("child_stderr_tail", []):
+            print(f"   {ln}", file=sys.stderr)
+    print("(set LLMLB_INIT_TIMEOUT=0 to skip this guard, or "
+          "JAX_PLATFORMS=cpu to serve on CPU)", file=sys.stderr)
+    print("=" * 72, file=sys.stderr)
+    raise SystemExit(
+        f"TPU backend init did not complete within {timeout_s:.0f}s: {diag}"
+    )

@@ -39,7 +39,11 @@ from llmlb_tpu.gateway.resilience import (
 )
 from llmlb_tpu.gateway.model_names import to_canonical
 from llmlb_tpu.gateway.token_accounting import estimate_tokens
-from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
+from llmlb_tpu.gateway.tracing import (
+    REQUEST_ID_HEADER,
+    TokenTimeline,
+    observe_first_token,
+)
 from llmlb_tpu.gateway.types import Capability, TpsApiKind
 from llmlb_tpu.structured import inspect_request as inspect_structured
 
@@ -547,6 +551,8 @@ async def messages(request: web.Request) -> web.StreamResponse:
         lease.complete_with_tokens(usage["input_tokens"],
                                    usage["output_tokens"])
         fo.record_success(endpoint)
+        # non-streaming goodput: only the TTFT target applies
+        state.metrics.record_slo(canonical, time.monotonic() - started, None)
         _record(state, endpoint=endpoint, model=canonical,
                 api_kind=TpsApiKind.CHAT, path="/v1/messages", status=200,
                 started=started,
@@ -595,10 +601,18 @@ async def _stream_transform(
     status = 200
     error = None
     upstream_failed = False
+    # Sampled token timeline + SLO inputs, same contract as the OpenAI
+    # passthrough (_forward_stream): one mark per upstream data chunk that
+    # produced client-visible events.
+    timeline = (TokenTimeline()
+                if trace is not None and state.traces.sample_timeline()
+                else None)
+    ttft_s: float | None = None
 
     async def pump(raw_chunk: bytes) -> None:
         nonlocal buffer
         buffer += raw_chunk
+        wrote = False
         while b"\n" in buffer:
             line, buffer = buffer.split(b"\n", 1)
             line = line.strip()
@@ -613,11 +627,15 @@ async def _stream_transform(
                 continue
             for event in encoder.feed(chunk):
                 await resp.write(event)
+                wrote = True
+        if wrote and timeline is not None:
+            timeline.mark()
 
     try:
         if first_chunk is not None:
             observe_first_token(state, trace, model, endpoint.name,
                                 started, streaming=True)
+            ttft_s = time.monotonic() - started
             await pump(first_chunk)
             while True:
                 try:
@@ -652,6 +670,12 @@ async def _stream_transform(
                             completed=status == 200)
         ct = encoder.usage["output_tokens"]
         duration_s = time.monotonic() - started
+        if trace is not None and timeline is not None:
+            trace.attach_timeline(timeline)
+        if status == 200 and ttft_s is not None:
+            itl_mean = (max(0.0, duration_s - ttft_s) / (ct - 1)
+                        if ct and ct > 1 else None)
+            state.metrics.record_slo(model, ttft_s, itl_mean)
         if ct:
             state.load_manager.update_tps(
                 endpoint.id, model, TpsApiKind.CHAT, ct, duration_s

@@ -37,7 +37,11 @@ from llmlb_tpu.gateway.token_accounting import (
     estimate_tokens,
     extract_usage_from_response,
 )
-from llmlb_tpu.gateway.tracing import REQUEST_ID_HEADER, observe_first_token
+from llmlb_tpu.gateway.tracing import (
+    REQUEST_ID_HEADER,
+    TokenTimeline,
+    observe_first_token,
+)
 from llmlb_tpu.gateway.types import Capability, Endpoint, TpsApiKind
 from llmlb_tpu.structured import inspect_request as inspect_structured
 
@@ -470,6 +474,13 @@ async def proxy_openai_post(
                 path=path, status=200, started=started,
                 prompt_tokens=usage[0], completion_tokens=usage[1],
                 client_ip=client_ip, auth=auth, request_body=stored_body)
+        # non-streaming goodput: the whole response IS the first token, so
+        # only the TTFT target applies (generation APIs only — embeddings
+        # and media have no latency SLO here)
+        if api_kind in (TpsApiKind.CHAT, TpsApiKind.COMPLETION,
+                        TpsApiKind.RESPONSES):
+            state.metrics.record_slo(canonical,
+                                     time.monotonic() - started, None)
         state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
         return web.Response(
             body=raw, status=200,
@@ -527,6 +538,13 @@ async def _forward_stream(
     await resp.prepare(request)
     lease.complete()  # endpoint accepted the stream; active slot released
     acc = StreamingTokenAccumulator()
+    # Sampled token timeline for the trace: one mark per SSE data chunk
+    # reaching the client, so /api/traces/<id> shows WHERE a slow stream
+    # stalled. ttft_s additionally feeds the SLO goodput ledger.
+    timeline = (TokenTimeline()
+                if trace is not None and state.traces.sample_timeline()
+                else None)
+    ttft_s: float | None = None
     status = 200
     error = None
     upstream_failed = False
@@ -534,8 +552,11 @@ async def _forward_stream(
         if first_chunk is not None:
             observe_first_token(state, trace, model, endpoint.name,
                                 started, streaming=True)
+            ttft_s = time.monotonic() - started
             acc.feed(first_chunk)
             await resp.write(first_chunk)
+            if timeline is not None and b"data:" in first_chunk:
+                timeline.mark()
             while True:
                 try:
                     chunk = await iterator.__anext__()
@@ -552,6 +573,8 @@ async def _forward_stream(
                     break
                 acc.feed(chunk)
                 await resp.write(chunk)
+                if timeline is not None and b"data:" in chunk:
+                    timeline.mark()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
             ConnectionResetError) as e:
         # resp.write failed: the CLIENT went away — not endpoint sickness,
@@ -571,6 +594,14 @@ async def _forward_stream(
                             completed=status == 200)
         pt, ct, reported = acc.finalize(prompt_text)
         duration_s = time.monotonic() - started
+        if trace is not None and timeline is not None:
+            trace.attach_timeline(timeline)
+        if status == 200 and ttft_s is not None:
+            # mean inter-token gap over the stream (None for single-token
+            # responses: only the TTFT target applies)
+            itl_mean = (max(0.0, duration_s - ttft_s) / (ct - 1)
+                        if ct > 1 else None)
+            state.metrics.record_slo(model, ttft_s, itl_mean)
         if ct > 0:
             state.load_manager.update_tps(
                 endpoint.id, model, api_kind, ct, duration_s

@@ -29,6 +29,7 @@ from llmlb_tpu.gateway.config import (
     QueueConfig,
     ResilienceConfig,
     ServerConfig,
+    SloConfig,
     env_int,
 )
 from llmlb_tpu.gateway.db import Database
@@ -101,7 +102,9 @@ async def build_app_state(
     events = DashboardEventBus()
     gate = InferenceGate()
     audit = AuditLog(db)
-    metrics = GatewayMetrics()
+    # SLO targets ride inside the metrics registry: every proxy path that
+    # finishes a successful request judges it there (record_slo)
+    metrics = GatewayMetrics(slo=SloConfig.from_env())
     admission.metrics = metrics  # admission-retry counter (balancer.py)
     traces = TraceStore(capacity=env_int("LLMLB_TRACE_BUFFER", 256),
                         events=events)

@@ -131,6 +131,64 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Latency-SLO targets for goodput accounting (gateway/metrics.py).
+
+    Goodput — the fraction of requests meeting their TTFT and inter-token
+    latency targets — is the serving figure that survives adversarial
+    traffic, where raw throughput lies ("Answer Fast" framing, PAPERS.md;
+    ROADMAP item 5). Targets are per model with a global default:
+
+        LLMLB_SLO_TTFT_MS      default TTFT target (default 2000)
+        LLMLB_SLO_ITL_MS       default mean-ITL target (default 200)
+        LLMLB_SLO_TARGETS      JSON per-model overrides, e.g.
+                               {"llama-3-8b": {"ttft_ms": 500, "itl_ms": 50}}
+        LLMLB_SLO=0            disable goodput accounting entirely
+    """
+
+    enabled: bool = True
+    ttft_target_s: float = 2.0
+    itl_target_s: float = 0.2
+    # model -> (ttft_target_s, itl_target_s); fall back to the defaults
+    per_model: dict = dataclasses.field(default_factory=dict)
+
+    def targets_for(self, model: str) -> tuple[float, float]:
+        override = self.per_model.get(model)
+        if override is not None:
+            return override
+        return self.ttft_target_s, self.itl_target_s
+
+    @classmethod
+    def from_env(cls) -> "SloConfig":
+        per_model: dict = {}
+        raw = env_str("LLMLB_SLO_TARGETS", "")
+        default_ttft = env_float("LLMLB_SLO_TTFT_MS", 2000.0) / 1000.0
+        default_itl = env_float("LLMLB_SLO_ITL_MS", 200.0) / 1000.0
+        if raw:
+            import json
+
+            try:
+                parsed = json.loads(raw)
+                for model, t in parsed.items():
+                    per_model[str(model)] = (
+                        float(t.get("ttft_ms", default_ttft * 1000)) / 1000.0,
+                        float(t.get("itl_ms", default_itl * 1000)) / 1000.0,
+                    )
+            except (ValueError, AttributeError, TypeError):
+                logging.getLogger("llmlb_tpu.gateway.config").warning(
+                    "LLMLB_SLO_TARGETS=%r is not a JSON object of "
+                    '{"model": {"ttft_ms": N, "itl_ms": N}}; ignoring', raw,
+                )
+                per_model = {}
+        return cls(
+            enabled=env_bool("LLMLB_SLO", True),
+            ttft_target_s=default_ttft,
+            itl_target_s=default_itl,
+            per_model=per_model,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServerConfig:
     host: str = "0.0.0.0"
     port: int = 32768  # reference default port

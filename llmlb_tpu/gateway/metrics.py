@@ -24,6 +24,12 @@ resilience-layer series (gateway/resilience.py):
   llmlb_gateway_breaker_state{endpoint}                  gauge (0/1/2)
   llmlb_gateway_stream_interruptions_total{model,endpoint} counter
   llmlb_gateway_faults_injected_total{kind}              counter
+SLO goodput series (targets from SloConfig, docs/profiling.md):
+  llmlb_gateway_slo_eligible_total{model}   counter (requests judged)
+  llmlb_gateway_slo_met_total{model}        counter (met every target)
+  llmlb_gateway_slo_ttft_miss_total{model}  counter
+  llmlb_gateway_slo_itl_miss_total{model}   counter
+  llmlb_gateway_goodput_ratio{model}        gauge (met / eligible)
 plus scrape-time gauges (active requests, admission queue depth, event-bus
 drops, trace-buffer size) injected by the /metrics handler.
 """
@@ -52,7 +58,10 @@ def _escape(value: str) -> str:
 
 
 class GatewayMetrics:
-    def __init__(self):
+    def __init__(self, slo=None):
+        # `slo` is a config.SloConfig (None: goodput accounting inert —
+        # the series still render, at zero, so dashboards never 404)
+        self.slo = slo
         self._lock = threading.Lock()
         self._requests: dict[tuple[str, int], int] = defaultdict(int)
         self._errors: dict[str, int] = defaultdict(int)
@@ -75,6 +84,12 @@ class GatewayMetrics:
         # at gateway-side validation (malformed / unsupported schema)
         self._structured_requests: dict[str, int] = defaultdict(int)
         self._structured_rejected = 0
+        # SLO goodput accounting: per-model attainment counters against the
+        # SloConfig targets; goodput_ratio renders as met/eligible
+        self._slo_eligible: dict[str, int] = defaultdict(int)
+        self._slo_met: dict[str, int] = defaultdict(int)
+        self._slo_ttft_miss: dict[str, int] = defaultdict(int)
+        self._slo_itl_miss: dict[str, int] = defaultdict(int)
 
     # ------------------------------------------------------------ recorders
 
@@ -149,6 +164,29 @@ class GatewayMetrics:
         with self._lock:
             self._structured_rejected += 1
 
+    def record_slo(self, model: str, ttft_s: float | None,
+                   itl_mean_s: float | None) -> None:
+        """Judge one SUCCESSFUL inference request against its model's SLO
+        targets. `ttft_s` is client-observed time to first byte/response;
+        `itl_mean_s` is the mean inter-token gap over the stream (None for
+        non-streaming or single-token responses — only the TTFT target
+        applies then). Failed requests are never goodput, but they are
+        already counted by errors_total; this ledger answers the narrower
+        'of the requests that succeeded, how many were fast enough'."""
+        if self.slo is None or not self.slo.enabled or ttft_s is None:
+            return
+        ttft_target, itl_target = self.slo.targets_for(model)
+        ttft_miss = ttft_s > ttft_target
+        itl_miss = itl_mean_s is not None and itl_mean_s > itl_target
+        with self._lock:
+            self._slo_eligible[model] += 1
+            if ttft_miss:
+                self._slo_ttft_miss[model] += 1
+            if itl_miss:
+                self._slo_itl_miss[model] += 1
+            if not (ttft_miss or itl_miss):
+                self._slo_met[model] += 1
+
     def _observe(self, table: dict, buckets: tuple[float, ...],
                  model: str, endpoint: str, seconds: float) -> None:
         with self._lock:
@@ -202,6 +240,13 @@ class GatewayMetrics:
                 "structured_requests_total":
                     sum(self._structured_requests.values()),
                 "structured_rejected_total": self._structured_rejected,
+                "slo_eligible_total": sum(self._slo_eligible.values()),
+                "slo_met_total": sum(self._slo_met.values()),
+                "goodput_ratio": (
+                    round(sum(self._slo_met.values())
+                          / sum(self._slo_eligible.values()), 4)
+                    if self._slo_eligible else None
+                ),
                 "ttft_s": pcts(self._ttft),
                 "e2e_s": pcts(self._e2e),
                 "queue_wait_s": pcts(self._queue_wait),
@@ -305,6 +350,23 @@ class GatewayMetrics:
                 f"llmlb_gateway_structured_rejected_total "
                 f"{self._structured_rejected}"
             )
+            for fam, table in (
+                ("llmlb_gateway_slo_eligible_total", self._slo_eligible),
+                ("llmlb_gateway_slo_met_total", self._slo_met),
+                ("llmlb_gateway_slo_ttft_miss_total", self._slo_ttft_miss),
+                ("llmlb_gateway_slo_itl_miss_total", self._slo_itl_miss),
+            ):
+                lines.append(f"# TYPE {fam} counter")
+                for model, n in sorted(table.items()):
+                    lines.append(f'{fam}{{model="{_escape(model)}"}} {n}')
+            lines.append("# TYPE llmlb_gateway_goodput_ratio gauge")
+            for model, eligible in sorted(self._slo_eligible.items()):
+                if eligible > 0:
+                    ratio = self._slo_met.get(model, 0) / eligible
+                    lines.append(
+                        f'llmlb_gateway_goodput_ratio'
+                        f'{{model="{_escape(model)}"}} {round(ratio, 6)}'
+                    )
             for name, table in (
                 ("llmlb_gateway_ttft_seconds", self._ttft),
                 ("llmlb_gateway_e2e_seconds", self._e2e),

@@ -44,6 +44,43 @@ def mint_request_id(raw: str | None) -> str:
     return uuid.uuid4().hex
 
 
+class TokenTimeline:
+    """Bounded per-request token timing marks for streamed responses: one
+    monotonic stamp per SSE data chunk that reached the client. Attached to
+    the request's trace, it shows WHERE a slow stream stalled (a late first
+    mark = prefill/queueing; a gap mid-stream = a slow step, page-pool
+    eviction, or an engine hiccup) — the per-request view the ITL histogram
+    averages away. Cost: one clock read + one list append per chunk, capped
+    at MAX_MARKS; marks beyond the cap keep counting but record nothing."""
+
+    MAX_MARKS = 256
+
+    def __init__(self):
+        self.marks: list[float] = []
+        self.count = 0
+
+    def mark(self) -> None:
+        self.count += 1
+        if len(self.marks) < self.MAX_MARKS:
+            self.marks.append(time.monotonic())
+
+    def payload(self, trace_t0: float) -> dict:
+        """JSON block for the trace: offsets from request arrival (ms),
+        plus the largest inter-mark gap — the stall, pre-located."""
+        marks_ms = [round((m - trace_t0) * 1000.0, 3) for m in self.marks]
+        max_gap = 0.0
+        for a, b in zip(marks_ms, marks_ms[1:]):
+            max_gap = max(max_gap, b - a)
+        return {
+            "chunks": self.count,
+            "truncated": self.count > len(self.marks),
+            "first_ms": marks_ms[0] if marks_ms else None,
+            "last_ms": marks_ms[-1] if marks_ms else None,
+            "max_gap_ms": round(max_gap, 3),
+            "marks_ms": marks_ms,
+        }
+
+
 class RequestTrace:
     """Ordered spans over one request's lifetime. Touched only from the
     event loop; durations come from one monotonic clock."""
@@ -62,6 +99,8 @@ class RequestTrace:
         self.duration_ms: float | None = None
         self.spans: list[dict] = []
         self._open: dict[str, int] = {}  # name -> index into spans
+        # sampled streamed-token timeline (TokenTimeline.payload shape)
+        self.token_timeline: dict | None = None
 
     # --------------------------------------------------------------- spans
 
@@ -125,8 +164,12 @@ class RequestTrace:
             "name": "done", "start_ms": round(now_ms, 3), "duration_ms": 0.0,
         })
 
+    def attach_timeline(self, timeline: "TokenTimeline") -> None:
+        if timeline.count:
+            self.token_timeline = timeline.payload(self.t0)
+
     def to_dict(self) -> dict:
-        return {
+        d = {
             "trace_id": self.trace_id,
             "method": self.method,
             "path": self.path,
@@ -139,18 +182,44 @@ class RequestTrace:
             "duration_ms": self.duration_ms,
             "spans": self.spans,
         }
+        if self.token_timeline is not None:
+            d["token_timeline"] = self.token_timeline
+        return d
 
 
 class TraceStore:
     """Bounded ring of completed traces + the in-flight set. Thread-safe:
     completion may be observed from bench/scrape threads."""
 
-    def __init__(self, capacity: int = 256, events=None):
+    def __init__(self, capacity: int = 256, events=None,
+                 timeline_interval: int | None = None):
         self.capacity = max(1, capacity)
         self._events = events  # DashboardEventBus | None
         self._lock = threading.Lock()
         self._active: "OrderedDict[str, RequestTrace]" = OrderedDict()
         self._done: deque[RequestTrace] = deque(maxlen=self.capacity)
+        # token-timeline sampling: every Nth streamed request carries marks
+        # (1 = all streams, 0 = none; LLMLB_TRACE_TIMELINE_SAMPLE)
+        if timeline_interval is None:
+            import os
+
+            try:
+                timeline_interval = int(
+                    os.environ.get("LLMLB_TRACE_TIMELINE_SAMPLE", "1")
+                )
+            except ValueError:
+                timeline_interval = 1
+        self.timeline_interval = max(0, timeline_interval)
+        self._timeline_seen = 0
+
+    def sample_timeline(self) -> bool:
+        """Decide (round-robin over streamed requests) whether this stream
+        records a TokenTimeline — bounded cost under sampling pressure."""
+        if self.timeline_interval <= 0:
+            return False
+        with self._lock:
+            self._timeline_seen += 1
+            return (self._timeline_seen - 1) % self.timeline_interval == 0
 
     def __len__(self) -> int:
         with self._lock:

@@ -173,6 +173,40 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
                 prom[f"{short}_p{p}_ms"] = (round(v * 1000, 3)
                                             if v is not None else None)
 
+        # SLO goodput: the same attainment counters Prometheus scrapes
+        # (llmlb_gateway_slo_*), summarized as the bench's goodput line
+        resp = await gw.client.get("/metrics")
+        exposition = await resp.text()
+
+        def slo_sum(name: str) -> float:
+            total = 0.0
+            for line in exposition.splitlines():
+                if line.startswith(name + "{") or line.startswith(name + " "):
+                    total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        eligible = slo_sum("llmlb_gateway_slo_eligible_total")
+        met = slo_sum("llmlb_gateway_slo_met_total")
+        slo_cfg = gw.state.metrics.slo
+        goodput = {
+            "slo_eligible": int(eligible),
+            "slo_met": int(met),
+            "ratio": round(met / eligible, 4) if eligible else None,
+            "ttft_miss": int(slo_sum("llmlb_gateway_slo_ttft_miss_total")),
+            "itl_miss": int(slo_sum("llmlb_gateway_slo_itl_miss_total")),
+            "ttft_target_ms": (round(slo_cfg.ttft_target_s * 1000, 1)
+                               if slo_cfg else None),
+            "itl_target_ms": (round(slo_cfg.itl_target_s * 1000, 1)
+                              if slo_cfg else None),
+        }
+        print(
+            f"[bench] goodput: {goodput['slo_met']}/{goodput['slo_eligible']}"
+            f" requests met SLO (ratio {goodput['ratio']}, TTFT target "
+            f"{goodput['ttft_target_ms']}ms, ITL target "
+            f"{goodput['itl_target_ms']}ms)",
+            file=sys.stderr,
+        )
+
         latencies.sort()
 
         def pct(p: float) -> float:
@@ -192,6 +226,7 @@ async def run_bench(seconds: float, concurrency: int) -> dict:
             "p50_ms": round(1000 * pct(0.50), 2),
             "p90_ms": round(1000 * pct(0.90), 2),
             "p99_ms": round(1000 * pct(0.99), 2),
+            "goodput": goodput,
             "prometheus": prom,
             "native_router": gw.state.load_manager.stats().get(
                 "native_router", False

@@ -1,18 +1,25 @@
 #!/usr/bin/env python
-"""Cross-check exported metric names against docs/monitoring/README.md.
+"""Cross-check exported metric names against docs/monitoring/README.md —
+and the monitoring ASSETS against the exporters.
 
-Every Prometheus series the engine and gateway registries can emit must be
-named VERBATIM somewhere in docs/monitoring/README.md — new gauges (like the
-page-pool family) cannot ship undocumented. Wired as a tier-1 test
-(tests/test_metrics_docs.py); also runnable standalone:
+Two directions, both wired as tier-1 tests (tests/test_metrics_docs.py);
+also runnable standalone:
 
     python scripts/check_metrics_docs.py
 
-Enumeration is by rendering the real registries (with every optional block
-enabled and one sample recorded per labeled family, so conditional series
-render too) plus the scrape-time gauge/counter literals the gateway /metrics
-handler injects (regex over llmlb_tpu/gateway/app.py — they live in a dict
-at the call site, not in the registry).
+1. Every Prometheus series the engine and gateway registries can emit must
+   be named VERBATIM somewhere in docs/monitoring/README.md — new gauges
+   (like the page-pool family) cannot ship undocumented. Enumeration is by
+   rendering the real registries (with every optional block enabled and one
+   sample recorded per labeled family, so conditional series render too)
+   plus the scrape-time gauge/counter literals the gateway /metrics handler
+   injects (regex over llmlb_tpu/gateway/app.py — they live in a dict at
+   the call site, not in the registry).
+
+2. Every llmlb_* series referenced by docs/monitoring/grafana-tpu-engine.json
+   and prometheus-alerts.yml must exist in the exportable set, so dashboards
+   and alert rules cannot drift from the exporters (a renamed gauge breaks
+   the build, not the on-call's 3am debugging session).
 """
 
 from __future__ import annotations
@@ -23,9 +30,17 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 DOCS = REPO / "docs" / "monitoring" / "README.md"
+GRAFANA = REPO / "docs" / "monitoring" / "grafana-tpu-engine.json"
+ALERTS = REPO / "docs" / "monitoring" / "prometheus-alerts.yml"
 
 _TYPE_RE = re.compile(r"^# TYPE (\S+) ", re.MULTILINE)
 _GATEWAY_LITERAL_RE = re.compile(r'"(llmlb_gateway_[a-z0-9_]+)"')
+# two segments minimum after the prefix: skips prose like "llmlb_gateway_*"
+# and module paths like "llmlb_tpu/gateway" in asset comments
+_SERIES_RE = re.compile(r"\b(llmlb_[a-z0-9]+(?:_[a-z0-9]+)+)\b")
+_CLOUD_LITERAL_RE = re.compile(r"(llmlb_cloud_[a-z0-9_]+)")
+# histogram exposition suffixes resolve to their family name
+_HIST_SUFFIX_RE = re.compile(r"_(bucket|sum|count)$")
 
 
 def engine_metric_names() -> set[str]:
@@ -47,14 +62,19 @@ def engine_metric_names() -> set[str]:
             "utilization": 0.0, "fragmentation": 0.0,
             "waste_tokens_mean": 0.0,
         },
+        perf={
+            "available": True, "mfu": 0.0, "hbm_bw_utilization": 0.0,
+            "flops_per_token": 0.0, "bytes_per_token": 0.0,
+        },
     )
     return set(_TYPE_RE.findall(text))
 
 
 def gateway_metric_names() -> set[str]:
+    from llmlb_tpu.gateway.config import SloConfig
     from llmlb_tpu.gateway.metrics import GatewayMetrics
 
-    g = GatewayMetrics()
+    g = GatewayMetrics(slo=SloConfig())
     # one sample per labeled family so every series renders
     g.record_request("/v1/chat/completions", 500)
     g.record_retry("chat")
@@ -72,6 +92,7 @@ def gateway_metric_names() -> set[str]:
     g.record_fault_injected("connect_refused")
     g.record_structured_request("json_schema")
     g.record_structured_rejected()
+    g.record_slo("m", 0.01, 0.01)  # SLO goodput family
     names = set(_TYPE_RE.findall(g.render()))
     # scrape-time gauges/counters injected by the /metrics handler
     app_src = (REPO / "llmlb_tpu" / "gateway" / "app.py").read_text()
@@ -79,22 +100,62 @@ def gateway_metric_names() -> set[str]:
     return names
 
 
+def cloud_metric_names() -> set[str]:
+    """llmlb_cloud_* series from the cloud-proxy exposition builder (string
+    literals in api_cloud.py; suffixed bucket/sum/count lines resolve to
+    their histogram family)."""
+    src = (REPO / "llmlb_tpu" / "gateway" / "api_cloud.py").read_text()
+    return {
+        _HIST_SUFFIX_RE.sub("", n) for n in _CLOUD_LITERAL_RE.findall(src)
+    }
+
+
+def exportable_names() -> set[str]:
+    return (engine_metric_names() | gateway_metric_names()
+            | cloud_metric_names())
+
+
+def referenced_series(*paths: Path) -> set[str]:
+    """Every llmlb_* series named in the monitoring assets (dashboard
+    exprs, alert exprs), suffix-normalized to family names."""
+    names: set[str] = set()
+    for path in paths:
+        for n in _SERIES_RE.findall(path.read_text()):
+            names.add(_HIST_SUFFIX_RE.sub("", n))
+    return names
+
+
 def undocumented(names: set[str], docs_text: str) -> list[str]:
     return sorted(n for n in names if n not in docs_text)
 
 
+def unknown_references(referenced: set[str],
+                       exportable: set[str]) -> list[str]:
+    return sorted(n for n in referenced if n not in exportable)
+
+
 def main() -> int:
     docs_text = DOCS.read_text()
-    missing = undocumented(engine_metric_names() | gateway_metric_names(),
-                           docs_text)
+    rc = 0
+    missing = undocumented(exportable_names(), docs_text)
     if missing:
         print("metric names exported but not documented in "
               f"{DOCS.relative_to(REPO)}:", file=sys.stderr)
         for name in missing:
             print(f"  - {name}", file=sys.stderr)
-        return 1
-    print("all exported metric names are documented")
-    return 0
+        rc = 1
+    dangling = unknown_references(referenced_series(GRAFANA, ALERTS),
+                                  exportable_names())
+    if dangling:
+        print("series referenced by dashboards/alerts but exported by "
+              "nothing:", file=sys.stderr)
+        for name in dangling:
+            print(f"  - {name}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("all exported metric names are documented and every "
+              "dashboard/alert series exists")
+    return rc
 
 
 if __name__ == "__main__":

@@ -92,6 +92,8 @@ def test_engine_metrics_exposition_valid():
         m.record_itl(s)
     m.record_prefill_step(0.03)
     m.record_decode_step(0.006, active_slots=5)
+    m.record_step_phases({"dispatch": 0.001, "compute": 0.004,
+                          "fetch": 0.0002, "emit": 0.0001}, slow=True)
     m.record_request_done("stop")
     m.record_request_done("error")
     text = m.render(queue_depth=2, active_slots=5, num_slots=8)
@@ -102,8 +104,17 @@ def test_engine_metrics_exposition_valid():
         "llmlb_engine_prefill_step_seconds",
         "llmlb_engine_decode_step_seconds",
         "llmlb_engine_schema_compile_seconds",
+        "llmlb_engine_step_phase_seconds",
     }
     assert "llmlb_engine_batch_occupancy 5" in text
+    assert "llmlb_engine_slow_steps_total 1" in text
+    # every phase of the taxonomy renders its own labeled series, observed
+    # or not (dashboards see a complete label set)
+    from llmlb_tpu.engine.stepstats import PHASES
+
+    phase_labels = {dict(k).get("phase")
+                    for f, k in hists if f == "llmlb_engine_step_phase_seconds"}
+    assert phase_labels == set(PHASES)
 
 
 def test_gateway_metrics_exposition_valid():
@@ -167,6 +178,35 @@ def test_percentile_above_top_edge_reports_max():
     assert Histogram((1.0,)).percentile(50) is None
 
 
+def test_percentile_empty_histogram_is_none_for_every_pct():
+    """Empty histograms must report None at every percentile — not 0, not
+    an edge — so /api/health consumers can tell 'no data' from 'fast'."""
+    h = Histogram((0.5, 1.0, 2.0))
+    for pct in (0.1, 1, 50, 99, 100):
+        assert h.percentile(pct) is None
+    # and an empty histogram still renders a valid exposition block
+    m = EngineMetrics()
+    assert_valid_exposition(m.render(queue_depth=0, active_slots=0,
+                                     num_slots=1))
+
+
+def test_percentile_single_bucket_interpolation():
+    """All mass in ONE bucket: percentiles interpolate linearly between the
+    bucket's lower and upper edge, never snap to an edge."""
+    h = Histogram((1.0, 2.0, 4.0))
+    for _ in range(10):
+        h.observe(1.5)  # lands in (1.0, 2.0]
+    # uniform-within-bucket: pN = 1.0 + N/100 * (2.0 - 1.0)
+    assert h.percentile(10) == pytest.approx(1.1)
+    assert h.percentile(50) == pytest.approx(1.5)
+    assert h.percentile(90) == pytest.approx(1.9)
+    # single-bucket histogram (one finite edge): same rule against lower=0
+    h1 = Histogram((2.0,))
+    h1.observe(0.5)
+    h1.observe(1.5)
+    assert h1.percentile(50) == pytest.approx(1.0)
+
+
 # ------------------------------------------------------------- tracing unit
 
 
@@ -219,6 +259,89 @@ def test_trace_spans_ordered_and_closed_on_finish():
     assert starts == sorted(starts)
     assert all(s["duration_ms"] is not None and s["duration_ms"] >= 0
                for s in t.spans)
+
+
+# ---------------------------------------------------------- SLO goodput
+
+
+def test_record_slo_judges_against_targets():
+    from llmlb_tpu.gateway.config import SloConfig
+
+    cfg = SloConfig(ttft_target_s=0.5, itl_target_s=0.05,
+                    per_model={"fast": (0.1, 0.01)})
+    g = GatewayMetrics(slo=cfg)
+    g.record_slo("m", 0.2, 0.01)          # met
+    g.record_slo("m", 0.9, 0.01)          # ttft miss
+    g.record_slo("m", 0.2, 0.2)           # itl miss
+    g.record_slo("m", 0.9, 0.2)           # both miss
+    g.record_slo("m", 0.2, None)          # non-streaming: TTFT only, met
+    g.record_slo("fast", 0.2, None)       # per-model override: 0.1s → miss
+    g.record_slo("m", None, None)         # no first byte: not judged
+    text = g.render()
+    assert 'llmlb_gateway_slo_eligible_total{model="m"} 5' in text
+    assert 'llmlb_gateway_slo_met_total{model="m"} 2' in text
+    assert 'llmlb_gateway_slo_ttft_miss_total{model="m"} 2' in text
+    assert 'llmlb_gateway_slo_itl_miss_total{model="m"} 2' in text
+    assert 'llmlb_gateway_goodput_ratio{model="m"} 0.4' in text
+    assert 'llmlb_gateway_slo_ttft_miss_total{model="fast"} 1' in text
+    summary = g.summary()
+    assert summary["slo_eligible_total"] == 6
+    assert summary["goodput_ratio"] == pytest.approx(2 / 6, abs=1e-4)
+
+
+def test_record_slo_disabled_or_unconfigured_is_inert():
+    from llmlb_tpu.gateway.config import SloConfig
+
+    for g in (GatewayMetrics(),  # no config at all
+              GatewayMetrics(slo=SloConfig(enabled=False))):
+        g.record_slo("m", 0.1, 0.01)
+        text = g.render()
+        # families still render (dashboards never 404), at zero samples
+        assert "# TYPE llmlb_gateway_slo_eligible_total counter" in text
+        assert "llmlb_gateway_slo_eligible_total{" not in text
+        assert "# TYPE llmlb_gateway_goodput_ratio gauge" in text
+
+
+def test_slo_config_from_env_parses_overrides(monkeypatch):
+    from llmlb_tpu.gateway.config import SloConfig
+
+    monkeypatch.setenv("LLMLB_SLO_TTFT_MS", "1500")
+    monkeypatch.setenv("LLMLB_SLO_ITL_MS", "80")
+    monkeypatch.setenv("LLMLB_SLO_TARGETS",
+                       '{"llama-3-8b": {"ttft_ms": 500, "itl_ms": 50}}')
+    cfg = SloConfig.from_env()
+    assert cfg.targets_for("other") == (1.5, 0.08)
+    assert cfg.targets_for("llama-3-8b") == (0.5, 0.05)
+    # malformed JSON degrades to defaults, never raises
+    monkeypatch.setenv("LLMLB_SLO_TARGETS", "{not json")
+    assert SloConfig.from_env().targets_for("llama-3-8b") == (1.5, 0.08)
+
+
+# ------------------------------------------------------------ token timeline
+
+
+def test_token_timeline_bounded_and_payload():
+    from llmlb_tpu.gateway.tracing import TokenTimeline
+
+    tl = TokenTimeline()
+    for _ in range(TokenTimeline.MAX_MARKS + 10):
+        tl.mark()
+    assert tl.count == TokenTimeline.MAX_MARKS + 10
+    assert len(tl.marks) == TokenTimeline.MAX_MARKS
+    payload = tl.payload(tl.marks[0])
+    assert payload["truncated"] is True
+    assert payload["chunks"] == TokenTimeline.MAX_MARKS + 10
+    assert payload["first_ms"] == 0.0
+    assert payload["max_gap_ms"] >= 0.0
+    assert len(payload["marks_ms"]) == TokenTimeline.MAX_MARKS
+
+
+def test_trace_store_timeline_sampling_interval():
+    store = TraceStore(capacity=4, timeline_interval=3)
+    decisions = [store.sample_timeline() for _ in range(9)]
+    assert decisions == [True, False, False] * 3
+    assert not TraceStore(capacity=4,
+                          timeline_interval=0).sample_timeline()
 
 
 # -------------------------------------------------------- event bus drops
@@ -403,6 +526,122 @@ async def test_trace_completed_event_published():
             assert event["data"]["status"] == 200
         finally:
             gw.state.events.unsubscribe(sub_id)
+    finally:
+        await upstream.stop()
+        await gw.close()
+
+
+async def test_stream_trace_carries_token_timeline_and_goodput():
+    """A streamed request's trace carries the sampled token timeline
+    (first/last marks, max gap) and the gateway judges the request against
+    its SLO targets — counters + goodput ratio visible in /metrics."""
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="m1").start()
+    try:
+        gw.register_mock(upstream.url, ["m1"], name="ep-a")
+        headers = dict(await gw.inference_headers())
+        headers["X-Request-Id"] = "trace-timeline-1"
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1", "stream": True,
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers=headers,
+        )
+        assert resp.status == 200
+        body = await resp.text()
+        assert "[DONE]" in body
+
+        t = await gw.client.get("/api/traces/trace-timeline-1",
+                                headers=await gw.admin_headers())
+        trace = await t.json()
+        tl = trace.get("token_timeline")
+        assert tl is not None, trace
+        assert tl["chunks"] >= 1
+        assert tl["marks_ms"] and tl["first_ms"] is not None
+        assert tl["last_ms"] >= tl["first_ms"]
+        assert tl["max_gap_ms"] >= 0.0
+        assert tl["truncated"] is False
+
+        # goodput: the mock upstream answers instantly, so the request met
+        # its targets and the ledger says so
+        m = await gw.client.get("/metrics")
+        text = await m.text()
+        assert 'llmlb_gateway_slo_eligible_total{model="m1"} 1' in text
+        assert 'llmlb_gateway_slo_met_total{model="m1"} 1' in text
+        assert 'llmlb_gateway_goodput_ratio{model="m1"} 1.0' in text
+
+        # non-streaming requests are judged too (TTFT-only)
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1",
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers=await gw.inference_headers(),
+        )
+        assert resp.status == 200
+        await resp.read()
+        text = await (await gw.client.get("/metrics")).text()
+        assert 'llmlb_gateway_slo_eligible_total{model="m1"} 2' in text
+    finally:
+        await upstream.stop()
+        await gw.close()
+
+
+async def test_timeline_sampling_zero_disables_marks():
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="m1").start()
+    try:
+        gw.register_mock(upstream.url, ["m1"])
+        gw.state.traces.timeline_interval = 0  # operator disabled sampling
+        headers = dict(await gw.inference_headers())
+        headers["X-Request-Id"] = "trace-no-tl"
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1", "stream": True,
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers=headers,
+        )
+        assert resp.status == 200
+        await resp.read()
+        t = await gw.client.get("/api/traces/trace-no-tl",
+                                headers=await gw.admin_headers())
+        assert "token_timeline" not in await t.json()
+    finally:
+        await upstream.stop()
+        await gw.close()
+
+
+async def test_api_traces_endpoint_ring_wraparound():
+    """/api/traces over HTTP with a tiny ring: older traces fall off, the
+    buffered gauge tracks the ring size, and evicted ids 404."""
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="m1").start()
+    try:
+        gw.register_mock(upstream.url, ["m1"])
+        # shrink the ring in place (handlers read state.traces live)
+        gw.state.traces = TraceStore(capacity=3)
+        headers = dict(await gw.inference_headers())
+        for i in range(5):
+            headers["X-Request-Id"] = f"wrap-{i}"
+            resp = await gw.client.post(
+                "/v1/chat/completions",
+                json={"model": "m1",
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers=headers,
+            )
+            assert resp.status == 200
+            await resp.read()
+        lst = await gw.client.get("/api/traces",
+                                  headers=await gw.admin_headers())
+        ids = [t["trace_id"] for t in (await lst.json())["traces"]]
+        assert ids == ["wrap-4", "wrap-3", "wrap-2"]
+        gone = await gw.client.get("/api/traces/wrap-0",
+                                   headers=await gw.admin_headers())
+        assert gone.status == 404
+        kept = await gw.client.get("/api/traces/wrap-4",
+                                   headers=await gw.admin_headers())
+        assert (await kept.json())["status"] == 200
+        text = await (await gw.client.get("/metrics")).text()
+        assert "llmlb_gateway_traces_buffered 3" in text
     finally:
         await upstream.stop()
         await gw.close()

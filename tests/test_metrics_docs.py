@@ -36,3 +36,25 @@ def test_checker_catches_missing_names():
     assert check_metrics_docs.undocumented(
         {"llmlb_engine_not_a_real_metric"}, check_metrics_docs.DOCS.read_text()
     ) == ["llmlb_engine_not_a_real_metric"]
+
+
+def test_dashboard_and_alert_series_exist():
+    """Every llmlb_* series referenced by the Grafana dashboard and the
+    alert rules must be exportable by some registry — dashboards cannot
+    drift from the exporters."""
+    referenced = check_metrics_docs.referenced_series(
+        check_metrics_docs.GRAFANA, check_metrics_docs.ALERTS
+    )
+    assert referenced, "asset parsing must find series (not vacuous)"
+    dangling = check_metrics_docs.unknown_references(
+        referenced, check_metrics_docs.exportable_names()
+    )
+    assert not dangling, f"dashboard/alert series exported by nothing: {dangling}"
+
+
+def test_reference_checker_catches_unknown_series():
+    """The cross-check itself must flag a made-up series name."""
+    assert check_metrics_docs.unknown_references(
+        {"llmlb_engine_not_a_real_metric"},
+        check_metrics_docs.exportable_names(),
+    ) == ["llmlb_engine_not_a_real_metric"]
