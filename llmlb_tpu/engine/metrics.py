@@ -113,6 +113,15 @@ class EngineMetrics:
         self.mask_cache_misses_total = 0
         self.mask_cache_evictions_total = 0
         self.schema_compile = Histogram(COMPILE_BUCKETS)
+        # Speculative decoding (llmlb_tpu/spec): verify dispatches run,
+        # draft tokens proposed, drafts accepted by the model, and tokens
+        # emitted by verify steps (accepted + 1 per speculating slot).
+        # acceptance rate = accepted / drafted; speedup proxy =
+        # emitted / verify steps per slot.
+        self.spec_verify_steps_total = 0
+        self.spec_draft_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
+        self.spec_emitted_tokens_total = 0
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -208,6 +217,17 @@ class EngineMetrics:
         with self._lock:
             self.mask_cache_evictions_total += 1
 
+    def record_spec_step(self, drafted: int, accepted: int,
+                         emitted: int) -> None:
+        """One speculative verify dispatch: `drafted` tokens proposed across
+        the batch, `accepted` of them matched by the model's own samples,
+        `emitted` tokens delivered (accepted + 1 per speculating slot)."""
+        with self._lock:
+            self.spec_verify_steps_total += 1
+            self.spec_draft_tokens_total += drafted
+            self.spec_accepted_tokens_total += accepted
+            self.spec_emitted_tokens_total += emitted
+
     def record_step_phases(self, phases: dict[str, float],
                            slow: bool = False) -> None:
         """One locked update per step: every phase duration plus the
@@ -252,6 +272,14 @@ class EngineMetrics:
                 "constraint_violations_total":
                     self.constraint_violations_total,
                 "schema_compile_p50_s": self.schema_compile.percentile(50),
+                "spec_verify_steps_total": self.spec_verify_steps_total,
+                "spec_draft_tokens_total": self.spec_draft_tokens_total,
+                "spec_accepted_tokens_total": self.spec_accepted_tokens_total,
+                "spec_acceptance_rate": (
+                    round(self.spec_accepted_tokens_total
+                          / self.spec_draft_tokens_total, 4)
+                    if self.spec_draft_tokens_total else None
+                ),
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
@@ -322,6 +350,18 @@ class EngineMetrics:
                 f"{self.mask_cache_evictions_total}",
                 "# TYPE llmlb_engine_slow_steps_total counter",
                 f"llmlb_engine_slow_steps_total {self.slow_steps_total}",
+                "# TYPE llmlb_engine_spec_verify_steps_total counter",
+                "llmlb_engine_spec_verify_steps_total "
+                f"{self.spec_verify_steps_total}",
+                "# TYPE llmlb_engine_spec_draft_tokens_total counter",
+                "llmlb_engine_spec_draft_tokens_total "
+                f"{self.spec_draft_tokens_total}",
+                "# TYPE llmlb_engine_spec_accepted_tokens_total counter",
+                "llmlb_engine_spec_accepted_tokens_total "
+                f"{self.spec_accepted_tokens_total}",
+                "# TYPE llmlb_engine_spec_emitted_tokens_total counter",
+                "llmlb_engine_spec_emitted_tokens_total "
+                f"{self.spec_emitted_tokens_total}",
             ]
             if perf is not None and perf.get("available"):
                 lines += [

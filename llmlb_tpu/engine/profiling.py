@@ -52,6 +52,11 @@ class ProfileManager:
         self._timer: threading.Timer | None = None
         self._captures: list[dict] = []  # completed, newest last
         self._root_override = trace_root
+        # Set while no capture runs, cleared for the duration of one:
+        # wait_idle() parks on it instead of polling status() — set/clear
+        # only ever happen with _lock held, so waiters can't miss an edge.
+        self._idle = threading.Event()
+        self._idle.set()
 
     # ---------------------------------------------------------------- control
 
@@ -89,6 +94,7 @@ class ProfileManager:
                 "seconds_requested": seconds,
             }
             self._active = capture
+            self._idle.clear()
             self._timer = threading.Timer(seconds, self._auto_stop,
                                           args=(capture["capture_id"],))
             self._timer.daemon = True
@@ -132,6 +138,7 @@ class ProfileManager:
                 self._timer.cancel()
                 self._timer = None
             self._active = None
+            self._idle.set()
         try:
             jax.profiler.stop_trace()
         except Exception as e:
@@ -166,6 +173,12 @@ class ProfileManager:
         out = dict(capture)
         out["download"] = f"/api/profile/{capture['capture_id']}"
         return out
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block (a worker thread — never the event loop) until the running
+        capture finishes, waking on the stop itself rather than polling
+        status(). True when idle; False when the timeout passed first."""
+        return self._idle.wait(timeout_s)
 
     def status(self) -> dict:
         with self._lock:

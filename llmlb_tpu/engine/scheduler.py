@@ -19,6 +19,12 @@ JetStream-style serving loop, TPU-first:
   slot to a refcounted radix tree keyed on prompt token ids; a later request
   sharing a prefix copies the cached rows with one device-side slice
   (no recompute) and chunk-prefills only the uncached suffix.
+- Speculative decoding (llmlb_tpu/spec, docs/speculative.md): per-slot
+  prompt-lookup drafters propose up to K tokens; one batched K+1-token
+  verify dispatch through the extend path scores them all, the longest
+  prefix matching the model's own samples is accepted (1..K+1 tokens per
+  step), rejected suffixes roll back committed length and release
+  over-allocated KV pages.
 
 The reference has no equivalent (it proxies to external runtimes, SURVEY.md L0);
 this is the in-tree `tpu://` engine of the BASELINE.json north star.
@@ -49,6 +55,7 @@ from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
 from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
+from llmlb_tpu.spec import PromptLookupDrafter, SpecConfig
 from llmlb_tpu.structured.constraint import ConstraintState, TokenConstraint
 
 log = logging.getLogger("llmlb_tpu.engine")
@@ -135,6 +142,27 @@ def _copy_kv_prefix(cache_k, cache_v, src_slot, dst_slot, rows):
     )
 
 
+def _sample_chunk(logits, key, temps, top_ps, top_ks, seeds, mask, start_pos):
+    """Per-position sampling for a verify chunk: [B, T, V] logits sampled as
+    B*T independent rows with each slot's params repeated per position and
+    the seed fold stepped by GLOBAL position (start + offset) — so a seeded
+    row draws the exact same key at sequence position p whether p was
+    reached by plain decode or inside a verify chunk (spec on/off produce
+    bit-identical seeded streams). `mask` is an optional [B*T, V] additive
+    grammar bias (per-position FSM lookahead rows)."""
+    b, t, v = logits.shape
+    flat = logits.reshape(b * t, v)
+
+    def rep(x):
+        return jnp.repeat(x, t)
+
+    steps = (start_pos[:, None]
+             + jnp.arange(t, dtype=jnp.int32)[None, :]).reshape(-1)
+    toks = sample_tokens(flat, key, rep(temps), rep(top_ps), rep(top_ks),
+                         mask, rep(seeds), steps)
+    return toks.reshape(b, t)
+
+
 @dataclasses.dataclass
 class SamplingParams:
     temperature: float = 1.0
@@ -149,6 +177,11 @@ class SamplingParams:
     # JSON-safe, so it rides the multihost plan wire as-is. The compiled
     # token-DFA travels separately on Request.compiled_constraint.
     constraint: dict | None = None
+    # Speculative decoding knobs (llmlb_tpu/spec): {"enabled": bool,
+    # "max_draft_tokens": int} — absent keys fall back to the engine
+    # defaults, max_draft_tokens clamps into the engine's verify width.
+    # JSON-safe, rides the plan wire like `constraint`.
+    speculative: dict | None = None
 
 
 @dataclasses.dataclass
@@ -200,6 +233,11 @@ class _Slot:
     # advanced host-side on every emitted token; its bias row is this slot's
     # stripe of the [B, V] decode mask.
     constraint: ConstraintState | None = None
+    # Speculative decoding (llmlb_tpu/spec): the per-request prompt-lookup
+    # index, fed every emitted token; None when this request does not
+    # speculate. spec_k is the request's draft budget per verify step.
+    drafter: PromptLookupDrafter | None = None
+    spec_k: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,6 +271,9 @@ class EngineCore:
         kv_layout: str | None = None,
         kv_page_size: int | None = None,
         kv_pages: int | None = None,
+        spec_decode: bool | None = None,
+        spec_max_draft: int | None = None,
+        spec_ngram: int | None = None,
     ):
         self.cfg = cfg
         # Family module (llama / mixtral) supplying the serving fns — one
@@ -481,6 +522,44 @@ class EngineCore:
         # rows×V·4B instead of slots×V·4B (32 MiB/token at 64×128k).
         self._mask_dirty_rows: set[int] = set()
         self._constrained_count = 0
+
+        # Speculative decoding (llmlb_tpu/spec): prompt-lookup drafting +
+        # batched K+1-token verification. `spec_decode` sets the DEFAULT for
+        # requests that do not carry their own `speculative` knob (a request
+        # may opt in on an engine defaulting off, and vice versa); the
+        # engine-level max_draft_tokens bounds the verify chunk width, so
+        # there is exactly one verify compile per window bucket. OFF by
+        # default: with no drafter attached anywhere the decode path is
+        # bit-identical to the pre-speculation engine.
+        if spec_decode is None:
+            spec_decode = os.environ.get(
+                "LLMLB_SPEC_DECODE", "0"
+            ).lower() in ("1", "true", "on", "yes")
+        if spec_max_draft is None:
+            spec_max_draft = int(os.environ.get("LLMLB_SPEC_MAX_DRAFT", "4"))
+        if spec_ngram is None:
+            spec_ngram = int(os.environ.get("LLMLB_SPEC_NGRAM", "3"))
+        self.spec = SpecConfig(
+            enabled=bool(spec_decode),
+            max_draft_tokens=max(1, min(int(spec_max_draft), 16)),
+            max_ngram=max(1, int(spec_ngram)),
+            min_ngram=1,
+        )
+        self._spec_available = hasattr(
+            self.family,
+            "verify_step_paged" if self.kv_layout == "paged" else "verify_step",
+        )
+        # jitted verify wrappers per context-window bucket (verify fn +
+        # per-position sampling fused into one dispatch, like _decode_many)
+        self._verify_fns: dict[int, Callable] = {}
+        # Per-position verify mask: a persistent [slots, K+1, V] device
+        # buffer (lazily allocated — spec-free and constraint-free engines
+        # never pay the HBM), refreshed per step ONLY for rows that are
+        # masked now or were last step (the lookahead states change every
+        # step, but unconstrained rows stay zero and never ship) — the
+        # verify-path analogue of the decode mask's dirty-row H2D contract.
+        self._d_spec_mask: jnp.ndarray | None = None
+        self._spec_masked_prev: set[int] = set()
 
         # Decode burst: number of decode+sample steps fused into ONE device
         # dispatch (lax.scan with on-device token feedback) per host readback.
@@ -936,17 +1015,23 @@ class EngineCore:
             self._d_block_tables = jnp.asarray(self._block_tables)
             self._tables_dirty = False
 
-    def _ensure_decode_pages(self, active: list[int], k: int) -> list[int]:
+    def _ensure_decode_pages(self, active: list[int], k: int,
+                             per_row: dict[int, int] | None = None
+                             ) -> list[int]:
         """Alloc-on-extend before a decode dispatch: grow each active row's
-        page list to cover the k tokens the dispatch writes. Under pool
-        exhaustion prefix-cache pages are evicted first; if the pool STILL
-        cannot cover a row, that request finishes with 'length' — the step
-        loop must never crash or deadlock on a full pool. Returns the rows
-        that remain active."""
+        page list to cover the k tokens the dispatch writes (`per_row`
+        overrides k per slot — the verify dispatch writes a different chunk
+        per row, and padded positions beyond a row's allocation land on the
+        trash page, so over-allocating for them would just churn pages).
+        Under pool exhaustion prefix-cache pages are evicted first; if the
+        pool STILL cannot cover a row, that request finishes with 'length' —
+        the step loop must never crash or deadlock on a full pool. Returns
+        the rows that remain active."""
         kept = []
         for i in active:
             slot = self.slots[i]
-            target = min(int(self._seq_lens[i]) + k + 1, self.slot_capacity)
+            kk = per_row.get(i, k) if per_row is not None else k
+            target = min(int(self._seq_lens[i]) + kk + 1, self.slot_capacity)
             need = self._pages_for_tokens(target) - len(self._slot_pages[i])
             if need > 0:
                 fresh = self._try_reserve_pages(need)
@@ -972,6 +1057,8 @@ class EngineCore:
                     slot.generated = 0
                     slot.last_emit_at = 0.0
                     slot.first_pending = False
+                    slot.drafter = None
+                    slot.spec_k = 0
                     continue
                 self._extend_slot_pages(i, fresh)
             kept.append(i)
@@ -1229,7 +1316,9 @@ class EngineCore:
 
     def _attach_constraint(self, slot_id: int, request: Request) -> None:
         """Install the per-request FSM cursor and its initial mask stripe at
-        slot-claim time (every insert path funnels through here)."""
+        slot-claim time (every insert path funnels through here) — plus the
+        speculative drafter, which needs exactly the same claim-time hook."""
+        self._attach_spec(slot_id, request)
         if request.compiled_constraint is None:
             return
         state = ConstraintState(request.compiled_constraint)
@@ -1270,6 +1359,348 @@ class EngineCore:
             )
             self._mask_dirty_rows.clear()
         return self._d_mask
+
+    # ---------------------------------------------------- speculative decode
+
+    def _attach_spec(self, slot_id: int, request: Request) -> None:
+        """Install the per-request prompt-lookup drafter at slot-claim time.
+        Per-request `speculative` knobs override the engine default; the
+        draft budget clamps into the engine verify width so the chunk shape
+        (and therefore the jit cache) never varies per request."""
+        slot = self.slots[slot_id]
+        slot.drafter = None
+        slot.spec_k = 0
+        if not self._spec_available:
+            return
+        knobs = request.sampling.speculative
+        knobs = knobs if isinstance(knobs, dict) else {}
+        enabled = bool(knobs.get("enabled", self.spec.enabled))
+        if not enabled:
+            return
+        try:
+            k = int(knobs.get("max_draft_tokens")
+                    or self.spec.max_draft_tokens)
+        except (TypeError, ValueError):
+            k = self.spec.max_draft_tokens
+        slot.spec_k = max(1, min(k, self.spec.max_draft_tokens))
+        slot.drafter = PromptLookupDrafter(
+            request.prompt_ids,
+            max_ngram=self.spec.max_ngram, min_ngram=self.spec.min_ngram,
+        )
+
+    def _collect_drafts(
+        self, active: list[int]
+    ) -> tuple[dict[int, list[int]], dict[int, list[int]]]:
+        """Per-slot draft proposals for this step (empty for slots that are
+        not speculating, have no n-gram match, or no room to speculate), plus
+        each constrained slot's FSM-state path along its kept drafts — the
+        lookahead that builds the per-position verify masks."""
+        drafts: dict[int, list[int]] = {}
+        lookahead: dict[int, list[int]] = {}
+        for i in active:
+            slot = self.slots[i]
+            d: list[int] = []
+            # first_pending slots' last token is still device-only, so the
+            # drafter has not seen it — their proposal would continue the
+            # wrong suffix; they join the verify batch with a plain 1-token
+            # chunk and speculate from the next step.
+            if slot.drafter is not None and not slot.first_pending:
+                request = slot.request
+                room = self.slot_capacity - 2 - int(self._seq_lens[i])
+                budget = request.sampling.max_tokens - slot.generated - 1
+                k = min(slot.spec_k, room, budget)
+                if k > 0:
+                    d = slot.drafter.propose(k)
+                if d and slot.constraint is not None:
+                    d, states = self._constrained_draft_prefix(
+                        slot.constraint, d
+                    )
+                    lookahead[i] = states
+            drafts[i] = d
+        return drafts, lookahead
+
+    @staticmethod
+    def _constrained_draft_prefix(
+        state: ConstraintState, drafts: list[int]
+    ) -> tuple[list[int], list[int]]:
+        """Truncate a draft proposal at the first token the grammar FSM
+        disallows, walking a lookahead copy of the cursor (the live cursor
+        only advances on EMITTED tokens, in _emit). Returns (kept drafts,
+        FSM states after each kept draft, starting with the current state).
+        EOS never drafts: acceptance-to-stop is the model's call."""
+        tc = state.tc
+        s = state.state
+        kept: list[int] = []
+        states = [s]
+        if state.violated:
+            return kept, states
+        for t in drafts:
+            if (t == tc.eos_id or not 0 <= t < tc.allowed.shape[1]
+                    or not tc.allowed[s, t]):
+                break
+            nxt = tc.advance(s, t)
+            if nxt is None:
+                break
+            kept.append(t)
+            s = nxt
+            states.append(s)
+        return kept, states
+
+    def _trim_slot_pages(self, slot_id: int, keep_tokens: int) -> None:
+        """Rejected-draft rollback: release the trailing pages a verify
+        dispatch allocated beyond what the accepted length needs (kept:
+        enough to cover keep_tokens). Trailing pages are always this slot's
+        own fresh allocations — shared prefix pages sit at the front of the
+        row and committed length never rolls back below the prompt — so one
+        unref per page is exactly right and the pool's double-free guard
+        stays armed."""
+        if self.page_pool is None:
+            return
+        keep = self._pages_for_tokens(keep_tokens)
+        row = self._slot_pages[slot_id]
+        if len(row) <= keep:
+            return
+        for p in row[keep:]:
+            self.page_pool.unref(p)
+        del row[keep:]
+        self._block_tables[slot_id, keep:] = 0
+        self._tables_dirty = True
+
+    def _build_verify(self, window: int) -> Callable:
+        """Jit one fused verify dispatch for a context-window bucket: the
+        K+1-token extend (family verify step) plus per-position sampling —
+        one device program, one host readback per verify step. Returns
+        [B, K+2] tokens: column 0 echoes the input last-token column (the
+        deferred-first-emission ride-along, same contract as decode's
+        first_in row), columns 1.. are the model's samples per position."""
+        family, cfg, mesh = self.family, self.cfg, self.mesh
+
+        if self.page_pool is not None:
+            def run(params, ids, chunk_lens, start_pos, tables,
+                    cache_k, cache_v, temps, top_ps, top_ks, seeds, mask,
+                    key):
+                logits, cache_k, cache_v = family.verify_step_paged(
+                    params, cfg, ids, chunk_lens, start_pos, tables,
+                    cache_k, cache_v, mesh, window=window,
+                )
+                toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
+                                     seeds, mask, start_pos)
+                return (jnp.concatenate([ids[:, :1], toks], axis=1),
+                        cache_k, cache_v)
+
+            return jax.jit(run, donate_argnums=(5, 6))
+
+        def run(params, ids, chunk_lens, start_pos,
+                cache_k, cache_v, temps, top_ps, top_ks, seeds, mask, key):
+            slot_ids = jnp.arange(ids.shape[0], dtype=jnp.int32)
+            logits, cache_k, cache_v = family.verify_step(
+                params, cfg, ids, chunk_lens, start_pos, slot_ids,
+                cache_k, cache_v, mesh, window=window,
+            )
+            toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
+                                 seeds, mask, start_pos)
+            return (jnp.concatenate([ids[:, :1], toks], axis=1),
+                    cache_k, cache_v)
+
+        return jax.jit(run, donate_argnums=(4, 5))
+
+    def _verify_for(self, window: int) -> Callable:
+        with self._decode_many_lock:
+            fn = self._verify_fns.get(window)
+            if fn is None:
+                fn = self._build_verify(window)
+                self._verify_fns[window] = fn
+            return fn
+
+    def _verify_active(self, active: list[int], drafts: dict[int, list[int]],
+                       lookahead: dict[int, list[int]],
+                       draft_s: float) -> bool:
+        """One speculative verify step: dispatch every active slot's last
+        token + drafts as a K+1-token chunk through the extend path, sample
+        every position, accept the longest prefix of drafts matching the
+        model's own samples, emit accepted + 1 tokens per slot, roll back
+        rejected-suffix state (committed length + over-allocated pages)."""
+        k1 = self.spec.max_draft_tokens + 1
+        step_start = time.monotonic()
+        t_sync = time.perf_counter()
+        if self.page_pool is not None:
+            per_row = {i: len(drafts.get(i, ())) + 1 for i in active}
+            active = self._ensure_decode_pages(active, 1, per_row)
+            if not active:
+                self.metrics.set_batch_occupancy(0)
+                return True
+            self._sync_block_tables()
+
+        # Chunk arrays: active rows carry [last, d1..dm]; every other row
+        # (prefilling/parked/free) degenerates to a 1-token chunk writing
+        # garbage at its clamped last cell / trash page — exactly decode's
+        # garbage contract for non-active rows.
+        b = self.num_slots
+        ids = np.zeros((b, k1), np.int32)
+        chunk_lens = np.ones((b,), np.int32)
+        start_pos = np.full((b,), self.slot_capacity - 1, np.int32)
+        for i in active:
+            d = drafts.get(i, ())
+            ids[i, 1:1 + len(d)] = d
+            chunk_lens[i] = 1 + len(d)
+            start_pos[i] = self._seq_lens[i]
+
+        # Per-position grammar masks: column 0 is the live cursor's row,
+        # later columns the FSM lookahead along the (pre-validated) drafts.
+        # Only rows masked this step or last (stale rows zero out) are
+        # built host-side and scattered into the persistent device buffer.
+        masked = [i for i in active if self.slots[i].constraint is not None]
+        mask = None
+        if masked or self._spec_masked_prev:
+            rows_upd = sorted(set(masked) | self._spec_masked_prev)
+            v = self.cfg.vocab_size
+            if self._d_spec_mask is None:
+                self._d_spec_mask = jnp.zeros((b, k1, v), jnp.float32)
+            stripes = np.zeros((len(rows_upd), k1, v), np.float32)
+            for n, i in enumerate(rows_upd):
+                state = self.slots[i].constraint
+                if state is None:
+                    continue  # left the masked set: the zero stripe clears it
+                stripes[n, 0] = state.bias_row()
+                states = lookahead.get(i, [state.state])
+                for j, s in enumerate(states[1:], start=1):
+                    # tc.bias_row handles dead-end states with the same
+                    # EOS-only fallback as the live cursor
+                    stripes[n, j] = state.tc.bias_row(s)
+                for j in range(max(1, len(states)), k1):
+                    stripes[n, j] = stripes[n, len(states) - 1]
+            self._d_spec_mask = self._d_spec_mask.at[
+                jnp.asarray(rows_upd, jnp.int32)
+            ].set(jnp.asarray(stripes))
+            self._spec_masked_prev = set(masked)
+        if masked:
+            mask = self._d_spec_mask.reshape(b * k1, -1)
+            self.metrics.record_masked_decode_step()
+        sync_s = time.perf_counter() - t_sync
+
+        self._key, sk = jax.random.split(self._key)
+        window = self._window_for(active, k1)
+        t_dispatch = time.perf_counter()
+        # column 0 is the on-device last token per row — newly activated
+        # slots' first tokens never round-tripped through the host
+        ids_dev = jnp.asarray(ids).at[:, 0].set(self._d_last_tokens)
+        fn = self._verify_for(window)
+        if self.page_pool is not None:
+            toks_dev, self.cache_k, self.cache_v = fn(
+                self.params, ids_dev, jnp.asarray(chunk_lens),
+                jnp.asarray(start_pos), self._d_block_tables,
+                self.cache_k, self.cache_v,
+                self._d_temps, self._d_top_ps, self._d_top_ks,
+                self._d_seeds, mask, sk,
+            )
+        else:
+            toks_dev, self.cache_k, self.cache_v = fn(
+                self.params, ids_dev, jnp.asarray(chunk_lens),
+                jnp.asarray(start_pos),
+                self.cache_k, self.cache_v,
+                self._d_temps, self._d_top_ps, self._d_top_ks,
+                self._d_seeds, mask, sk,
+            )
+        t_compute = time.perf_counter()
+        jax.block_until_ready(toks_dev)
+        t_fetch = time.perf_counter()
+        tokens = self._fetch_tokens(toks_dev)  # [B, K+2]: input col + samples
+        t_emit = time.perf_counter()
+        step_s = time.monotonic() - step_start
+
+        drafted = sum(len(drafts.get(i, ())) for i in active)
+        accepted_total = 0
+        emitted_total = 0  # every token delivered (all slots; MFU/throughput)
+        spec_emitted = 0  # tokens from SPECULATING slots (accepted + 1 each)
+        rows: list[int] = []
+        new_lens: list[int] = []
+        new_lasts: list[int] = []
+        for i in active:
+            slot = self.slots[i]
+            if slot.first_pending and slot.request is not None:
+                slot.first_pending = False
+                self._emit(i, int(tokens[i, 0]), first=True)
+            if slot.request is None or slot.prefilling:
+                continue
+            d = drafts.get(i, [])
+            # expected emission span (matches until first mismatch, +1 for
+            # the correction/bonus sample) — the amortized per-token pacing
+            # for this slot's ITL before finish conditions can trim it
+            span = 1
+            for j, dj in enumerate(d):
+                if int(tokens[i, 1 + j]) == dj and dj != self.eos_id:
+                    span += 1
+                else:
+                    break
+            itl = step_s / span
+            j = 0
+            emitted_i = 0
+            while True:
+                tok = int(tokens[i, 1 + j])
+                self._seq_lens[i] += 1
+                emitted_i += 1
+                matched = j < len(d) and tok == d[j]
+                self._emit(i, tok, itl=itl)
+                if matched:
+                    j += 1
+                if slot.request is None or not matched:
+                    break
+            accepted_total += j
+            emitted_total += emitted_i
+            if d:
+                spec_emitted += emitted_i
+            if slot.request is not None and not slot.prefilling:
+                rows.append(i)
+                new_lens.append(int(self._seq_lens[i]))
+                # the last emitted sample is the next dispatch's input token
+                new_lasts.append(int(tokens[i, emitted_i]))
+                # rejected-suffix rollback: keep pages covering the
+                # committed length + the next token's write, release the rest
+                self._trim_slot_pages(i, int(self._seq_lens[i]) + 1)
+        if rows:
+            idx = jnp.asarray(rows, jnp.int32)
+            self._d_seq_lens = self._d_seq_lens.at[idx].set(
+                jnp.asarray(new_lens, jnp.int32)
+            )
+            self._d_last_tokens = self._d_last_tokens.at[idx].set(
+                jnp.asarray(new_lasts, jnp.int32)
+            )
+
+        mean_span = emitted_total / max(1, len(active))
+        self.metrics.record_decode_step(step_s / max(1.0, mean_span),
+                                        len(active))
+        self.metrics.record_spec_step(drafted, accepted_total, spec_emitted)
+        self._record_step(
+            "verify",
+            {"draft": draft_s,
+             "host_sync": sync_s,
+             "dispatch": t_compute - t_dispatch,
+             "compute": t_fetch - t_compute,
+             "fetch": t_emit - t_fetch,
+             "emit": time.perf_counter() - t_emit},
+            active_slots=len(active), tokens=emitted_total,
+        )
+        return True
+
+    def spec_info(self) -> dict:
+        """Speculative-decoding block for /api/system, /api/health, and
+        /metrics consumers: config + live acceptance figures."""
+        m = self.metrics
+        drafted = m.spec_draft_tokens_total
+        return {
+            "enabled": self.spec.enabled,
+            "available": self._spec_available,
+            "max_draft_tokens": self.spec.max_draft_tokens,
+            "ngram": [self.spec.min_ngram, self.spec.max_ngram],
+            "verify_steps_total": m.spec_verify_steps_total,
+            "draft_tokens_total": drafted,
+            "accepted_tokens_total": m.spec_accepted_tokens_total,
+            "emitted_tokens_total": m.spec_emitted_tokens_total,
+            "acceptance_rate": (
+                round(m.spec_accepted_tokens_total / drafted, 4)
+                if drafted else None
+            ),
+        }
 
     def _release_cache_entry(self, slot: _Slot) -> None:
         if slot.cache_entry is not None:
@@ -1697,6 +2128,8 @@ class EngineCore:
             slot.request = None
             slot.prefilling = False
             slot.generated = 0
+            slot.drafter = None
+            slot.spec_k = 0
             return True
 
         n = len(request.prompt_ids)
@@ -1851,6 +2284,26 @@ class EngineCore:
             self.metrics.set_batch_occupancy(0)
             return False
 
+        # Speculative decoding: when any active slot proposes drafts, ONE
+        # verify dispatch replaces this step's decode — it scores all drafts
+        # plus a correction/bonus sample and emits 1..K+1 tokens per slot.
+        # Constrained slots ride the same dispatch with per-position FSM
+        # lookahead masks, so a JSON-mode request advances multi-token
+        # instead of forcing the whole batch into single-step decode. With
+        # no drafter attached anywhere this block is a no-op and the decode
+        # path below is bit-identical to the pre-speculation engine.
+        draft_s = 0.0
+        if self._spec_available and any(
+            self.slots[i].drafter is not None for i in active
+        ):
+            t_draft = time.perf_counter()
+            drafts, lookahead = self._collect_drafts(active)
+            draft_s = time.perf_counter() - t_draft
+            if any(drafts.values()):
+                return self._verify_active(active, drafts, lookahead, draft_s)
+            # no n-gram matched: fall through to plain decode; the draft
+            # time lands in this step's record below
+
         t_sync = time.perf_counter()
         if self.page_pool is not None:
             # alloc-on-extend: every page this dispatch writes must exist
@@ -1911,7 +2364,8 @@ class EngineCore:
             self._emit_fetched(tokens, active, itl=step_s)
             self._record_step(
                 "decode",
-                {"host_sync": sync_s,
+                {"draft": draft_s,
+                 "host_sync": sync_s,
                  "dispatch": t_compute - t_dispatch,
                  "compute": t_fetch - t_compute,
                  "fetch": t_emit - t_fetch,
@@ -1974,7 +2428,8 @@ class EngineCore:
         self._emit_fetched(tokens, active, itl=step_s)
         self._record_step(
             "decode",
-            {"host_sync": sync_s,
+            {"draft": draft_s,
+             "host_sync": sync_s,
              "dispatch": dispatch_s,
              "compute": t_fetch - t_compute,
              "fetch": t_emit - t_fetch,
@@ -2028,8 +2483,15 @@ class EngineCore:
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False
+            slot.drafter = None
+            slot.spec_k = 0
             return
         slot.generated += 1
+        # Incremental drafter update: every emitted token extends the
+        # prompt-lookup index (first_pending emissions included — the first
+        # token is part of the sequence the next proposal continues).
+        if slot.drafter is not None and token != self.eos_id:
+            slot.drafter.append(token)
         now = time.monotonic()
         if request.first_token_at is None:
             request.first_token_at = now
@@ -2089,6 +2551,8 @@ class EngineCore:
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False
+            slot.drafter = None
+            slot.spec_k = 0
 
     def _fail_all(self, message: str) -> None:
         for slot_id, slot in enumerate(self.slots):
@@ -2104,6 +2568,8 @@ class EngineCore:
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False
+            slot.drafter = None
+            slot.spec_k = 0
         if self._held_request is not None:
             self._held_request.events.put(("error", message))
             self.metrics.record_request_done("error")

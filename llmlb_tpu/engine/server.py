@@ -85,8 +85,35 @@ def _sampling_from(body: dict, default_max: int = 256) -> SamplingParams:
     if max_tokens < 1:
         raise ValueError("'max_tokens' must be >= 1")
     return SamplingParams(
-        temperature=temperature, top_p=top_p, top_k=top_k, max_tokens=max_tokens
+        temperature=temperature, top_p=top_p, top_k=top_k,
+        max_tokens=max_tokens, speculative=_speculative_from(body),
     )
+
+
+def _speculative_from(body: dict) -> dict | None:
+    """Per-request speculative-decoding knobs (an OpenAI-dialect extension,
+    also carried through the Anthropic adapter): `speculative: {enabled,
+    max_draft_tokens}`. Absent → engine defaults (--spec-decode /
+    LLMLB_SPEC_*). Validated here so a malformed knob 400s instead of being
+    silently ignored at the scheduler."""
+    spec = body.get("speculative")
+    if spec is None:
+        return None
+    if not isinstance(spec, dict):
+        raise ValueError("'speculative' must be an object")
+    out: dict = {}
+    if "enabled" in spec:
+        if not isinstance(spec["enabled"], bool):
+            raise ValueError("'speculative.enabled' must be a boolean")
+        out["enabled"] = spec["enabled"]
+    if spec.get("max_draft_tokens") is not None:
+        k = spec["max_draft_tokens"]
+        if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+            raise ValueError(
+                "'speculative.max_draft_tokens' must be a positive integer"
+            )
+        out["max_draft_tokens"] = k
+    return out or None
 
 
 def _stops_from(body: dict) -> list[str]:
@@ -295,6 +322,8 @@ class EngineAPI:
                 # the static slot-cache footprint
                 "kv_cache": self.engine.core.kv_cache_info(),
                 "structured": self.engine.core.structured_info(),
+                # speculative decoding: config + live acceptance figures
+                "spec": self.engine.core.spec_info(),
                 # live roofline: MFU / HBM-bandwidth utilization against the
                 # chip's peak specs (available only on chips in the table
                 # and once decode traffic has flowed)
@@ -423,12 +452,12 @@ class EngineAPI:
         except ProfileError as e:
             return _error(e.status, str(e))
         # the bounded auto-stop ends the capture even if the client leaves;
-        # this handler just waits for it so the response means "done"
-        deadline = time.monotonic() + seconds + 30.0
-        while time.monotonic() < deadline:
-            if not self.profiles.status()["recording"]:
-                break
-            await asyncio.sleep(0.05)
+        # this handler waits for the stop event itself (worker thread — no
+        # poll loop, and the event loop stays free for in-flight streams)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.profiles.wait_idle, seconds + 30.0
+        )
         return web.json_response({
             "trace_dir": started["trace_dir"],
             "seconds": started["seconds"],
@@ -908,6 +937,24 @@ def main(argv: list[str] | None = None) -> None:
              "requests)",
     )
     parser.add_argument(
+        "--spec-decode", choices=("on", "off"), default=None,
+        help="speculative decoding default for requests without their own "
+             "'speculative' knob (default off; also via LLMLB_SPEC_DECODE): "
+             "prompt-lookup drafting + batched K+1-token verification "
+             "(docs/speculative.md)",
+    )
+    parser.add_argument(
+        "--spec-max-draft", type=int, default=None,
+        help="max draft tokens per verify step (default 4, cap 16; also via "
+             "LLMLB_SPEC_MAX_DRAFT) — the verify chunk width, one compile "
+             "per context-window bucket",
+    )
+    parser.add_argument(
+        "--spec-ngram", type=int, default=None,
+        help="longest n-gram the prompt-lookup drafter matches on (default "
+             "3; also via LLMLB_SPEC_NGRAM)",
+    )
+    parser.add_argument(
         "--prefix-cache", choices=("on", "off"), default=None,
         help="radix-tree prefix KV reuse across requests (default on; "
              "also via LLMLB_PREFIX_CACHE=0)",
@@ -952,6 +999,12 @@ def main(argv: list[str] | None = None) -> None:
         extra["kv_page_size"] = max(1, args.kv_page_size)
     if args.kv_pages is not None:
         extra["kv_pages"] = max(2, args.kv_pages)
+    if args.spec_decode is not None:
+        extra["spec_decode"] = args.spec_decode == "on"
+    if args.spec_max_draft is not None:
+        extra["spec_max_draft"] = max(1, args.spec_max_draft)
+    if args.spec_ngram is not None:
+        extra["spec_ngram"] = max(1, args.spec_ngram)
     if args.prefix_cache is not None:
         extra["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_slots is not None:

@@ -328,6 +328,9 @@ class Engine:
             "prefix_cache": self.core.prefix_cache_info(),
             "kv_cache": self.core.kv_cache_info(),
             "structured": self.core.structured_info(),
+            # speculative decoding config + live acceptance figures
+            # (llmlb_tpu/spec, docs/speculative.md)
+            "spec": self.core.spec_info(),
             # live roofline (MFU / HBM-BW vs chip peaks, docs/profiling.md);
             # the gateway's telemetry-aware placement can read how close to
             # the hardware each engine is running

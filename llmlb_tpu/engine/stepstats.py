@@ -35,7 +35,21 @@ import threading
 import time
 from collections import deque
 
-PHASES = ("plan", "host_sync", "dispatch", "compute", "fetch", "emit")
+PHASES = ("plan", "draft", "host_sync", "dispatch", "compute", "fetch",
+          "emit")
+
+# Step kinds the scheduler dispatches. Each kind keeps its OWN EMA baseline
+# in the slow-step detector: a K+1-token speculative verify step is
+# legitimately several times a single-token decode step, so folding them
+# into one baseline would either flag every verify step or mask genuinely
+# slow decodes.
+#   prefill — prompt KV fill (one-shot group, chunked extend, or CP pass)
+#   decode  — 1-token (or burst-scanned k-token) step, one token/slot/step
+#   verify  — speculative K+1-token verification (llmlb_tpu/spec): scores
+#             the drafts in one extend-style dispatch; `tokens` on its
+#             records counts tokens actually EMITTED (accepted + 1 per
+#             slot), not positions scored
+KINDS = ("prefill", "decode", "verify")
 
 # EMA smoothing for the per-kind step-time baseline. Small alpha: the
 # baseline should drift with load, not chase a single outlier.
@@ -110,7 +124,10 @@ class StepRecorder:
                 "tokens": tokens,
                 "slow": slow,
             })
-            if kind == "decode" and tokens > 0:
+            # decode AND verify steps feed the throughput window: both
+            # deliver committed tokens, and live MFU must see speculative
+            # throughput or it would collapse the moment speculation engages
+            if kind in ("decode", "verify") and tokens > 0:
                 self._window.append((total, tokens))
         return slow
 

@@ -143,7 +143,11 @@ def anthropic_request_to_openai(body: dict) -> dict:
         "max_tokens": body.get("max_tokens", 1024),
     }
     for src, dst in (("temperature", "temperature"), ("top_p", "top_p"),
-                     ("stream", "stream")):
+                     ("stream", "stream"),
+                     # speculative-decoding knobs ({enabled,
+                     # max_draft_tokens}) ride both dialects verbatim — the
+                     # engine validates and clamps them
+                     ("speculative", "speculative")):
         if body.get(src) is not None:
             out[dst] = body[src]
     if body.get("stop_sequences"):

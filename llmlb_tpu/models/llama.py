@@ -451,11 +451,14 @@ def prefill_into_slots(
 
 def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
                          cache_k, cache_v, *, stacked_names=None,
-                         mlp_fn=_default_mlp_fn):
+                         mlp_fn=_default_mlp_fn, all_logits=False, window=None):
     """Shared chunked-prefill body: process a [B, T] chunk of prompt tokens
     whose slots already hold `start_pos` tokens of KV. Queries attend over the
     full slot row (earlier chunks + causal within this chunk). Backs long
-    prompts that exceed the one-shot prefill buckets.
+    prompts that exceed the one-shot prefill buckets, and — with
+    `all_logits=True` — the speculative verify step, which needs logits at
+    EVERY chunk position, not just the last. `window` (static) bounds how
+    much of the capacity axis attention reads, same contract as decode.
 
     Padding tokens (i >= chunk_lens) write garbage K/V at positions beyond the
     chunk; those cells sit past the valid range (masked by every later
@@ -479,8 +482,12 @@ def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids
             nonlocal ck, cv  # cache write precedes attention over the cache
             ck = ck.at[slot_ids[:, None], write_pos].set(k.astype(ck.dtype))
             cv = cv.at[slot_ids[:, None], write_pos].set(v.astype(cv.dtype))
+            k_rows, v_rows = ck[slot_ids], cv[slot_ids]
+            if window is not None and window < capacity:
+                k_rows = lax.slice_in_dim(k_rows, 0, window, axis=1)
+                v_rows = lax.slice_in_dim(v_rows, 0, window, axis=1)
             return gqa_attention_extend(
-                q, ck[slot_ids], cv[slot_ids], positions, chunk_lens
+                q, k_rows, v_rows, positions, chunk_lens
             )
 
         carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
@@ -490,6 +497,10 @@ def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
 
+    if all_logits:
+        b = x.shape[0]
+        logits = _unembed(cfg, params, x.reshape(b * t, -1)).reshape(b, t, -1)
+        return logits, cache_k, cache_v
     last = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
     logits = _unembed(cfg, params, x_last)
@@ -543,17 +554,22 @@ def prefill_into_pages(
 
 def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
                                block_tables, cache_k, cache_v, *,
-                               stacked_names=None, mlp_fn=_default_mlp_fn):
+                               stacked_names=None, mlp_fn=_default_mlp_fn,
+                               all_logits=False, window=None):
     """Paged counterpart of _prefill_extend_impl: the chunk's KV scatters
     through the block table into the page pool and attention reads the pool
     via ops.attention.paged_attention_extend. Padding tokens write garbage
     past the chunk — into this row's own later pages or the trash page
-    (unallocated table entries), never another row's cells."""
+    (unallocated table entries), never another row's cells. `all_logits`
+    returns logits at every chunk position (the speculative verify step);
+    `window` (static) bounds the attention sweep to whole pages covering it,
+    same contract as paged decode."""
     from llmlb_tpu.ops.attention import paged_attention_extend
 
     _, t = input_ids.shape
     ps = cache_k.shape[2]
-    capacity = block_tables.shape[1] * ps
+    ppn = block_tables.shape[1]
+    capacity = ppn * ps
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     offs = jnp.arange(t, dtype=jnp.int32)[None, :]
     positions = start_pos[:, None] + offs  # [B, T] global positions
@@ -561,6 +577,13 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
     page = jnp.take_along_axis(block_tables, write_pos // ps, axis=1)
     off = write_pos % ps
     token_valid = offs < chunk_lens[:, None]  # [B, T]
+    # attention sweeps only the pages covering `window` (writes keep the full
+    # table: write_pos clamps into capacity, not the window)
+    read_tables = block_tables
+    if window is not None and -(-window // ps) < ppn:
+        read_tables = lax.slice_in_dim(
+            block_tables, 0, max(1, -(-window // ps)), axis=1
+        )
 
     x = params["embed"][input_ids]  # [B, T, E]
     stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
@@ -573,7 +596,7 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
             ck = ck.at[page, off].set(k.astype(ck.dtype))
             cv = cv.at[page, off].set(v.astype(cv.dtype))
             return paged_attention_extend(
-                q, ck, cv, block_tables, positions, chunk_lens
+                q, ck, cv, read_tables, positions, chunk_lens
             )
 
         carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
@@ -583,6 +606,10 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
 
+    if all_logits:
+        b = x.shape[0]
+        logits = _unembed(cfg, params, x.reshape(b * t, -1)).reshape(b, t, -1)
+        return logits, cache_k, cache_v
     last = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, E]
     logits = _unembed(cfg, params, x_last)
@@ -608,6 +635,55 @@ def prefill_extend_pages(
     return _prefill_extend_paged_impl(
         params, cfg, input_ids, chunk_lens, start_pos, block_tables,
         cache_k, cache_v,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
+def verify_step(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, K+1] int32 — last committed token + drafts
+    chunk_lens: jnp.ndarray,  # [B] int32 — 1 + draft count per row
+    start_pos: jnp.ndarray,  # [B] int32 — committed tokens in the row's cache
+    slot_ids: jnp.ndarray,  # [B] int32 — target rows (engine passes arange)
+    cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D]
+    cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
+    window: int | None = None,  # static context-window bucket
+):
+    """Speculative verification over the dense slot cache: one extend-style
+    dispatch scores the last committed token plus up to K draft tokens,
+    returning logits at EVERY chunk position ([B, K+1, V] fp32) so the
+    scheduler can sample each position and accept the longest matching
+    draft prefix. KV for all chunk positions is written; rejected-suffix
+    cells become garbage past the rolled-back length (standard contract)."""
+    return _prefill_extend_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
+        cache_k, cache_v, all_logits=True, window=window,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
+def verify_step_paged(
+    params: Params,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,  # [B, K+1] int32 — last committed token + drafts
+    chunk_lens: jnp.ndarray,  # [B] int32 — 1 + draft count per row
+    start_pos: jnp.ndarray,  # [B] int32 — committed tokens in the row's pages
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    cache_k: jnp.ndarray,  # [L, P, PS, K, D]
+    cache_v: jnp.ndarray,
+    mesh: Mesh | None = None,  # unused; shared family signature
+    window: int | None = None,  # static context-window bucket
+):
+    """Paged speculative verification: same contract as verify_step with the
+    slot cache swapped for the page pool + block tables — the K+1-token
+    ragged extend the paged attention kernels were built for."""
+    return _prefill_extend_paged_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, block_tables,
+        cache_k, cache_v, all_logits=True, window=window,
     )
 
 

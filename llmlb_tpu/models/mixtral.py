@@ -268,6 +268,38 @@ def prefill_extend_pages(params, cfg: MixtralConfig, input_ids, chunk_lens,
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
          donate_argnames=("cache_k", "cache_v"))
+def verify_step(params, cfg: MixtralConfig, input_ids, chunk_lens, start_pos,
+                slot_ids, cache_k, cache_v, mesh: Mesh | None = None,
+                window: int | None = None):
+    """Speculative verification over the dense slot cache. Same contract as
+    llama.verify_step; exact MoE like decode — capacity drops would make a
+    draft's acceptance depend on which other slots share the batch."""
+    return _prefill_extend_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
+        cache_k, cache_v, stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
+        all_logits=True, window=window,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
+def verify_step_paged(params, cfg: MixtralConfig, input_ids, chunk_lens,
+                      start_pos, block_tables, cache_k, cache_v,
+                      mesh: Mesh | None = None, window: int | None = None):
+    """Paged speculative verification. Same contract as
+    llama.verify_step_paged; exact MoE for the same batch-independence
+    reason as decode_step."""
+    return _prefill_extend_paged_impl(
+        params, cfg, input_ids, chunk_lens, start_pos, block_tables,
+        cache_k, cache_v, stacked_names=_STACKED,
+        mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
+        all_logits=True, window=window,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
+         donate_argnames=("cache_k", "cache_v"))
 def decode_step_paged(params, cfg: MixtralConfig, input_ids, seq_lens,
                       cache_k, cache_v, block_tables,
                       mesh: Mesh | None = None, window: int | None = None):

@@ -1,0 +1,26 @@
+"""Draft-free speculative decoding: n-gram/prompt-lookup drafting.
+
+The decode loop's biggest structural cost is one device round trip per
+emitted token per sequence. Speculative decoding breaks that coupling:
+a cheap *drafter* proposes the next K tokens, the target model scores all
+K+1 positions in ONE batched extend-style step over the paged KV cache
+(the Ragged Paged Attention shape from PR 3), and the scheduler accepts
+the longest prefix of drafts that match the model's own sampled tokens.
+Every verify step emits between 1 and K+1 tokens — and because the model
+samples every emitted token itself, the output distribution is exactly
+the non-speculative one (see docs/speculative.md for the argument).
+
+This package is the *drafting* side: `PromptLookupDrafter` is a per-slot
+suffix-match n-gram index over the request's prompt + generated tokens —
+no second model, no extra HBM, microseconds per proposal. It shines
+precisely on the workloads the engine already optimizes for: shared-prefix
+chat (answers quote the prompt) and structured output (JSON keys repeat).
+
+Scheduler wiring (verify dispatch, acceptance walk, KV-page rollback,
+constraint lookahead) lives in engine/scheduler.py; the K+1 model step in
+models/llama.py `verify_step{,_paged}`.
+"""
+
+from llmlb_tpu.spec.drafter import PromptLookupDrafter, SpecConfig
+
+__all__ = ["PromptLookupDrafter", "SpecConfig"]

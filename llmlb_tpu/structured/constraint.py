@@ -135,7 +135,19 @@ class TokenConstraint:
         with self._bias_lock:
             row = self._bias_rows.get(state)
             if row is None:
-                row = np.where(self.allowed[state], np.float32(0.0), MASK_NEG)
+                if not self.allowed[state].any():
+                    # Dead-end state (vocabulary gap): fail open to EOS so
+                    # the request terminates instead of sampling an
+                    # arbitrary all-blocked token. One shared fallback for
+                    # the live cursor AND speculative lookahead masks —
+                    # callers that need to count the violation check
+                    # allowed[state].any() themselves (ConstraintState).
+                    row = np.full((self.allowed.shape[1],), MASK_NEG,
+                                  dtype=np.float32)
+                    row[self.eos_id] = np.float32(0.0)
+                else:
+                    row = np.where(self.allowed[state], np.float32(0.0),
+                                   MASK_NEG)
                 self._bias_rows[state] = row
         return row
 
@@ -168,13 +180,9 @@ class ConstraintState:
         if not self.tc.allowed[self.state].any():
             # No token can advance the grammar from here (vocabulary gap —
             # e.g. a tokenizer with no token for a required character).
-            # Fail open to EOS so the slot frees; the scheduler counts it
-            # as a constraint violation.
+            # tc.bias_row fails open to EOS so the slot frees; the live
+            # cursor additionally marks the violation for accounting.
             self.violated = True
-            fallback = np.full((self.tc.allowed.shape[1],), MASK_NEG,
-                               dtype=np.float32)
-            fallback[self.tc.eos_id] = np.float32(0.0)
-            return fallback
         return self.tc.bias_row(self.state)
 
     def advance(self, token_id: int) -> bool:

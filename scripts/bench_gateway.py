@@ -606,6 +606,142 @@ async def run_structured_bench(requests: int) -> dict:
         engine.shutdown()
 
 
+async def run_spec_bench(requests: int) -> dict:
+    """Speculative-decoding workload: predictable continuations (shared-
+    prefix chat + JSON-mode structured output) through the full gateway
+    against a real tpu:// engine (CPU backend), run twice — speculation on
+    and off — on otherwise identical engines. Reports drafted/accepted
+    tokens, acceptance rate, and decode tok/s for both modes; the JSON-mode
+    half must stay 100% schema-valid under speculation."""
+    import jsonschema
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+    from llmlb_tpu.gateway.types import Capability
+    from tests.support import GatewayHarness
+
+    # An array of identical items: grammar + greedy decode make the
+    # continuation maximally predictable — the structured shape speculation
+    # exists to accelerate (acceptance approaches 1).
+    schema = {"type": "array", "items": {"enum": ["aa"]},
+              "minItems": 20, "maxItems": 20}
+    system = ("You are the TPU serving assistant. Answer briefly and "
+              "cite the runbook section when relevant. ") * 2
+
+    async def run_mode(spec: bool) -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-spec", num_slots=4,
+            slot_capacity=512, prefill_buckets=(16, 32, 64),
+            spec_decode=spec, spec_max_draft=6,
+        )
+        eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(
+                f"http://127.0.0.1:{eng_server.port}", [engine.model_id],
+                capabilities=[Capability.CHAT_COMPLETION,
+                              Capability.STRUCTURED_OUTPUTS],
+            )
+            headers = dict(await gw.inference_headers())
+
+            async def one(i: int, constrained: bool) -> dict:
+                payload = {
+                    "model": engine.model_id,
+                    "messages": [
+                        {"role": "system", "content": system},
+                        {"role": "user",
+                         "content": f"question {i}: 1 2 3 4 5 6 7 8"},
+                    ],
+                    "max_tokens": 140, "temperature": 0.0, "stream": True,
+                }
+                if constrained:
+                    payload["response_format"] = {
+                        "type": "json_schema",
+                        "json_schema": {"name": "items", "schema": schema},
+                    }
+                t0 = time.perf_counter()
+                ttft = None
+                text = ""
+                tokens = 0
+                resp = await gw.client.post("/v1/chat/completions",
+                                            json=payload, headers=headers)
+                assert resp.status == 200, await resp.text()
+                async for raw in resp.content:
+                    line = raw.decode(errors="replace").strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    chunk = json.loads(line[len("data: "):])
+                    for c in chunk.get("choices", []):
+                        if c.get("delta", {}).get("content"):
+                            if ttft is None:
+                                ttft = time.perf_counter() - t0
+                            text += c["delta"]["content"]
+                    usage = chunk.get("usage")
+                    if usage:
+                        tokens = usage.get("completion_tokens", 0)
+                await resp.release()
+                e2e = time.perf_counter() - t0
+                if constrained:
+                    jsonschema.validate(json.loads(text), schema)
+                return {"tokens": tokens,
+                        "decode_s": max(1e-9, e2e - (ttft or 0.0))}
+
+            # XLA warmup outside the timed window (incl. one of each shape)
+            await one(0, False)
+            await one(0, True)
+
+            t0 = time.perf_counter()
+            rows = await asyncio.gather(*(
+                one(i, i % 2 == 0) for i in range(requests)
+            ))
+            wall = time.perf_counter() - t0
+            m = engine.core.metrics
+            tokens = sum(r["tokens"] for r in rows)
+            decode_s = sum(r["decode_s"] for r in rows)
+            drafted = m.spec_draft_tokens_total
+            return {
+                "spec_decode": spec,
+                "requests": requests,
+                "completion_tokens": tokens,
+                "wall_s": round(wall, 2),
+                "tok_per_s_wall": round(tokens / wall, 1),
+                # per-request decode time excludes each request's TTFT
+                # (prefill), summed across the concurrent batch
+                "decode_tok_per_s": round(tokens / decode_s, 1),
+                "verify_steps": m.spec_verify_steps_total,
+                "drafted_tokens": drafted,
+                "accepted_tokens": m.spec_accepted_tokens_total,
+                "emitted_tokens": m.spec_emitted_tokens_total,
+                "acceptance_rate": (
+                    round(m.spec_accepted_tokens_total / drafted, 3)
+                    if drafted else None
+                ),
+                "constraint_violations": m.constraint_violations_total,
+                "engine_spec": engine.core.spec_info(),
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    off = await run_mode(False)
+    on = await run_mode(True)
+    assert off["verify_steps"] == 0  # speculation off: path never dispatches
+    return {
+        "metric": "spec_decode_workload",
+        "requests": requests,
+        "speedup_wall": round(on["tok_per_s_wall"] / off["tok_per_s_wall"], 2),
+        "speedup_decode": round(
+            on["decode_tok_per_s"] / off["decode_tok_per_s"], 2
+        ),
+        "acceptance_rate": on["acceptance_rate"],
+        "spec_on": on,
+        "spec_off": off,
+    }
+
+
 async def run_chaos_bench(seconds: float, concurrency: int) -> dict:
     """Chaos drill: the real gateway + two stub endpoints serving one model,
     with one endpoint flapping hard (connect-refused injected at the proxy's
@@ -756,12 +892,12 @@ def main() -> None:
     parser.add_argument(
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
-                 "structured"),
+                 "structured", "spec-decode"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
                         help="request count for --workload shared-prefix / "
-                             "mixed-length / structured")
+                             "mixed-length / structured / spec-decode")
     args = parser.parse_args()
     if args.workload not in ("proxy", "chaos"):
         _pin_platform()  # engine workloads touch jax: decide platform first
@@ -769,6 +905,8 @@ def main() -> None:
         result = asyncio.run(run_prefix_bench(args.requests))
     elif args.workload == "structured":
         result = asyncio.run(run_structured_bench(args.requests))
+    elif args.workload == "spec-decode":
+        result = asyncio.run(run_spec_bench(args.requests))
     elif args.workload == "mixed-length":
         result = asyncio.run(run_mixed_length_bench(args.requests))
     elif args.workload == "chaos":

@@ -1,0 +1,110 @@
+"""Multihost plan-wire round trip: every SamplingParams field must survive
+serialization, so forgetting a field when adding a knob is a test failure
+instead of a silent multihost divergence (PR 5 shipped `constraint` over the
+wire by hand; `speculative` and whatever comes next ride the same check).
+
+The wire form is what EngineCore._plan_wire emits (leader) and what
+_apply_plan reconstructs (followers): dataclasses.asdict(sampling) →
+SamplingParams(**payload). These tests exercise exactly those two functions
+and synthesize a distinctive non-default value for EVERY declared field —
+a new field is covered the moment it is declared."""
+
+import dataclasses
+import pickle
+
+from llmlb_tpu.engine.scheduler import Request, SamplingParams
+
+
+def _distinct_value(field: dataclasses.Field):
+    """A JSON-safe value distinguishable from the field's default, derived
+    from the annotation so newly added fields get covered automatically."""
+    ann = str(field.type)
+    if "dict" in ann:
+        return {"probe": field.name, "n": 3}
+    if "bool" in ann:
+        default = field.default
+        return not default if isinstance(default, bool) else True
+    if "float" in ann:
+        return 0.125
+    if "int" in ann:
+        return 7
+    if "str" in ann:
+        return f"probe-{field.name}"
+    raise AssertionError(
+        f"SamplingParams.{field.name}: add a wire-probe rule for {ann!r} "
+        "(and make sure the field is JSON-safe for the plan broadcast)"
+    )
+
+
+def _probe_params() -> SamplingParams:
+    values = {
+        f.name: _distinct_value(f) for f in dataclasses.fields(SamplingParams)
+    }
+    return SamplingParams(**values)
+
+
+def _wire_roundtrip(request: Request) -> Request:
+    """The exact leader→follower path: _plan_wire's payload shape, through
+    pickle (the multihost broadcast encoding), back via _apply_plan's
+    constructor call."""
+    payload = {
+        "request_id": request.request_id,
+        "prompt_ids": list(request.prompt_ids),
+        "sampling": dataclasses.asdict(request.sampling),
+    }
+    payload = pickle.loads(pickle.dumps(payload))
+    return Request(
+        prompt_ids=payload["prompt_ids"],
+        sampling=SamplingParams(**payload["sampling"]),
+        request_id=payload["request_id"],
+    )
+
+
+def test_every_sampling_field_survives_the_wire():
+    params = _probe_params()
+    shadow = _wire_roundtrip(
+        Request(prompt_ids=[1, 2, 3], sampling=params)
+    ).sampling
+    for f in dataclasses.fields(SamplingParams):
+        assert getattr(shadow, f.name) == getattr(params, f.name), (
+            f"SamplingParams.{f.name} was lost or mangled on the plan wire"
+        )
+
+
+def test_probe_values_differ_from_defaults():
+    """The round-trip assertion above is only meaningful if the probe value
+    actually differs from the default (a dropped field that deserializes to
+    its default must FAIL the wire test)."""
+    params = _probe_params()
+    defaults = SamplingParams()
+    for f in dataclasses.fields(SamplingParams):
+        assert getattr(params, f.name) != getattr(defaults, f.name), (
+            f"probe for SamplingParams.{f.name} equals its default; "
+            "_distinct_value needs a better rule"
+        )
+
+
+def test_speculative_and_constraint_ride_the_wire_verbatim():
+    params = SamplingParams(
+        constraint={"type": "json_object"},
+        speculative={"enabled": True, "max_draft_tokens": 6},
+    )
+    shadow = _wire_roundtrip(
+        Request(prompt_ids=[5], sampling=params)
+    ).sampling
+    assert shadow.constraint == {"type": "json_object"}
+    assert shadow.speculative == {"enabled": True, "max_draft_tokens": 6}
+
+
+def test_plan_wire_matches_engine_implementation():
+    """Guard against _plan_wire/_apply_plan drifting from the shape this
+    test assumes: the real methods run against a core-free stub (they touch
+    no device state for the serialization itself)."""
+    from llmlb_tpu.engine.scheduler import EngineCore
+
+    req = Request(prompt_ids=[1, 2], sampling=_probe_params())
+    plan = {"new": [req], "cancelled": [], "stop": False}
+    wire = EngineCore._plan_wire(None, plan)  # self unused in _plan_wire
+    assert wire["new"][0]["sampling"] == dataclasses.asdict(req.sampling)
+    rebuilt = SamplingParams(**wire["new"][0]["sampling"])
+    assert rebuilt == req.sampling

@@ -383,3 +383,40 @@ def test_guard_backend_init_fails_fast_on_hang(monkeypatch, capsys):
     err = capsys.readouterr().err
     assert "libtpu: claiming device" in err  # the captured child log tail
     assert "LLMLB_INIT_TIMEOUT=0" in err
+
+
+def test_profile_wait_idle_wakes_on_stop_event_not_poll(tmp_path):
+    """The /debug/profile wait path parks on the manager's idle event and
+    wakes when the capture stops — the last 50 ms poll loop in a request
+    path, now notify-based. Regression bound: wake latency well under one
+    old poll tick."""
+    import threading
+
+    from llmlb_tpu.engine.profiling import ProfileManager
+
+    mgr = ProfileManager(trace_root=str(tmp_path))
+    assert mgr.wait_idle(0.01) is True  # idle from construction
+    mgr.start(30)
+    assert mgr.wait_idle(0.01) is False  # recording: the wait parks
+
+    woke_after = {}
+
+    def waiter():
+        t0 = time.perf_counter()
+        assert mgr.wait_idle(10.0) is True
+        woke_after["s"] = time.perf_counter() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    t_stop = time.perf_counter()
+    mgr.stop()
+    t.join(timeout=5)
+    stop_s = time.perf_counter() - t_stop
+    assert not t.is_alive()
+    # the waiter wakes with the stop itself, not a later poll tick; the
+    # bound subtracts stop_trace's own serialization time
+    assert woke_after["s"] - stop_s < 0.045, (
+        f"wait_idle woke {woke_after['s'] * 1000:.1f}ms after a "
+        f"{stop_s * 1000:.1f}ms stop — still polling?"
+    )

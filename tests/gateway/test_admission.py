@@ -154,6 +154,91 @@ def test_registry_changes_picked_up_on_retry():
     asyncio.run(run())
 
 
+def test_queue_wait_latency_regression_end_to_end():
+    """Latency regression over the full select_endpoint_with_queue path (the
+    handler-facing wrapper around the AdmissionQueue): a parked request must
+    admit within a release-notification latency — far under one 50 ms poll
+    tick — and the queue-timeout path must keep its semantics (QueueTimeout
+    carrying position + waited_s, which the handlers turn into 503 +
+    Retry-After)."""
+    import pytest
+
+    from llmlb_tpu.gateway.api_openai import (
+        QueueTimeout,
+        select_endpoint_with_queue,
+    )
+    from llmlb_tpu.gateway.types import Capability
+
+    class _Registry:
+        def __init__(self, endpoint):
+            self.endpoint = endpoint
+
+        def find_by_model(self, model, capability=None):
+            class _M:
+                model_id = "m"
+            return [(self.endpoint, _M())]
+
+    class _Metrics:
+        def record_queue_wait(self, *a):
+            pass
+
+        def record_queue_timeout(self, *a):
+            pass
+
+        def record_retry(self, *a):
+            pass
+
+    class _State:
+        pass
+
+    async def run():
+        lm = LoadManager(QueueConfig(max_active_per_endpoint=1,
+                                     queue_timeout_s=5.0))
+        state = _State()
+        state.load_manager = lm
+        state.admission = AdmissionQueue(lm)
+        state.admission.metrics = None
+        state.registry = _Registry(ep("a"))
+        state.metrics = _Metrics()
+
+        # saturate the single admission slot
+        first = await select_endpoint_with_queue(
+            state, "m", Capability.CHAT_COMPLETION, TpsApiKind.CHAT
+        )
+        assert first is not None
+        _, _, lease = first
+
+        async def parked():
+            return await select_endpoint_with_queue(
+                state, "m", Capability.CHAT_COMPLETION, TpsApiKind.CHAT
+            )
+
+        task = asyncio.create_task(parked())
+        await asyncio.sleep(0.02)
+        t0 = time.monotonic()
+        lease.complete()
+        second = await task
+        wake_ms = (time.monotonic() - t0) * 1000
+        assert second is not None
+        assert wake_ms < 40, f"queue-wait wake took {wake_ms:.1f}ms"
+        second[2].complete()
+
+        # timeout semantics intact: position + waited_s reach the handler
+        blocker = await select_endpoint_with_queue(
+            state, "m", Capability.CHAT_COMPLETION, TpsApiKind.CHAT
+        )
+        with pytest.raises(QueueTimeout) as exc:
+            await select_endpoint_with_queue(
+                state, "m", Capability.CHAT_COMPLETION, TpsApiKind.CHAT,
+                queue_timeout_s=0.1,
+            )
+        assert exc.value.queue_position == 1
+        assert exc.value.waited_s >= 0.1
+        blocker[2].complete()
+
+    asyncio.run(run())
+
+
 def test_recheck_tick_notices_new_endpoint_without_release():
     """Capacity appearing WITHOUT a lease release (endpoint registered or
     recovered mid-wait) is noticed by the bounded safety tick."""

@@ -213,3 +213,20 @@ def test_messages_endpoint_non_stream_and_stream():
             await mock.stop()
             await gw.close()
     asyncio.run(run())
+
+
+def test_speculative_knob_rides_the_anthropic_conversion():
+    """Per-request speculative-decoding knobs must reach the engine through
+    BOTH dialects; the Anthropic adapter carries them verbatim."""
+    body = {
+        "model": "m", "max_tokens": 16,
+        "messages": [{"role": "user", "content": "hi"}],
+        "speculative": {"enabled": True, "max_draft_tokens": 6},
+    }
+    out = anthropic_request_to_openai(body)
+    assert out["speculative"] == {"enabled": True, "max_draft_tokens": 6}
+    # absent stays absent — no key invented for engines that predate it
+    assert "speculative" not in anthropic_request_to_openai(
+        {"model": "m", "max_tokens": 16,
+         "messages": [{"role": "user", "content": "hi"}]}
+    )

@@ -1,0 +1,63 @@
+"""PromptLookupDrafter units: suffix matching, incremental updates, and the
+no-self-match property the one-behind indexing scheme guarantees."""
+
+import pytest
+
+from llmlb_tpu.spec import PromptLookupDrafter, SpecConfig
+
+
+def test_prompt_repeat_is_proposed():
+    # tail (1,2,3) occurred earlier at positions 0..2; continuation is 4,1,2,3
+    d = PromptLookupDrafter([1, 2, 3, 4, 1, 2, 3], max_ngram=3)
+    assert d.propose(4) == [4, 1, 2, 3]
+    assert d.propose(2) == [4, 1]
+
+
+def test_no_match_returns_empty():
+    d = PromptLookupDrafter([1, 2, 3, 4, 5], max_ngram=3)
+    assert d.propose(4) == []  # tail (3,4,5) / (4,5) / (5) never recurred
+
+
+def test_longest_ngram_wins():
+    # tail (7, 8): the 2-gram match at [7, 8, 9] must beat the 1-gram (8)
+    # match elsewhere — longer context, better continuation
+    d = PromptLookupDrafter([7, 8, 9, 8, 1, 7, 8], max_ngram=3)
+    assert d.propose(1) == [9]
+
+
+def test_most_recent_occurrence_wins():
+    # (5,) occurred twice; the LATER occurrence's continuation is proposed
+    d = PromptLookupDrafter([5, 1, 5, 2, 5], max_ngram=1)
+    assert d.propose(1) == [2]
+
+
+def test_tail_never_matches_itself():
+    # a repeated tail must find the EARLIER occurrence, not its own position
+    d = PromptLookupDrafter([3, 3], max_ngram=1)
+    assert d.propose(2) == [3]  # follows position 1 (after the first 3)
+    d2 = PromptLookupDrafter([9], max_ngram=1)
+    assert d2.propose(3) == []  # single occurrence: nothing earlier
+
+
+def test_incremental_append_extends_the_index():
+    d = PromptLookupDrafter([1, 2, 3], max_ngram=2)
+    assert d.propose(2) == []
+    for t in (9, 1, 2):  # generated tokens re-create the (1, 2) bigram tail
+        d.append(t)
+    assert d.propose(2) == [3, 9]
+    assert len(d) == 6
+
+
+def test_proposal_truncates_at_sequence_end():
+    d = PromptLookupDrafter([4, 5, 4, 5], max_ngram=2)
+    # tail (4,5) matched at positions 0..1 -> continuation [4, 5] then ends
+    assert d.propose(8) == [4, 5]
+
+
+def test_spec_config_validation():
+    with pytest.raises(ValueError):
+        SpecConfig(max_draft_tokens=0)
+    with pytest.raises(ValueError):
+        SpecConfig(min_ngram=3, max_ngram=2)
+    cfg = SpecConfig(enabled=True, max_draft_tokens=8, max_ngram=4)
+    assert cfg.min_ngram == 1 and cfg.max_draft_tokens == 8
