@@ -204,9 +204,14 @@ def run_engine_bench(platform: str) -> dict:
     # live llmlb_engine_mfu_ratio gauge divides by).
     from llmlb_tpu.engine.telemetry import chip_spec_for, model_flops_per_token
 
-    n_params = sum(int(np.prod(v.shape)) for v in core.params.values())
+    n_params = sum(int(np.prod(v.shape)) for k, v in core.params.items()
+                   if not k.endswith("_scale"))  # scales aren't parameters
     spec = chip_spec_for(kind)
-    mfu = (model_flops_per_token(cfg, n_params) * per_chip / spec.peak_flops
+    # weight-quantized engines are judged against the chip's int8 peak
+    # (same column the live gauge divides by — telemetry.ChipSpec)
+    peak = (spec.int8_flops if (spec and core.quant.weights)
+            else (spec.peak_flops if spec else None))
+    mfu = (model_flops_per_token(cfg, n_params) * per_chip / peak
            if (spec and on_tpu) else None)
     # the engine's own live figure over its recent decode window — should
     # track the bench's steady-state estimate on TPU
@@ -214,9 +219,15 @@ def run_engine_bench(platform: str) -> dict:
 
     kernels = "pallas" if (on_tpu and n_chips == 1 and os.environ.get(
         "LLMLB_TPU_ATTENTION", "auto") != "xla") else "xla"
+    # the engine resolves LLMLB_QUANTIZE itself; report what actually ran
+    # next to the MFU estimate so a quantized number is never mistaken for
+    # a bf16 one (int8 weights are judged against the int8 peak — the
+    # engine's perf_info already picks the right column)
+    quant_mode = core.quant.mode
     log(f"steady-state: {window_tokens} tokens / {window_s:.2f}s = "
         f"{toks_per_sec:.1f} tok/s ({per_chip:.1f}/chip), "
-        f"ttft p50 {ttft_p50_ms:.1f}ms, kernels={kernels}")
+        f"ttft p50 {ttft_p50_ms:.1f}ms, kernels={kernels}, "
+        f"mfu={mfu if mfu is not None else 'n/a'} quantize={quant_mode}")
 
     return {
         "metric": f"engine_decode_tokens_per_sec_per_chip_{preset}",
@@ -236,6 +247,7 @@ def run_engine_bench(platform: str) -> dict:
             round(long_ttft_ms, 1) if long_ttft_ms is not None else None
         ),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "quantize": quant_mode,
         "engine_mfu_live": engine_perf.get("mfu"),
         "engine_hbm_bw_utilization_live": engine_perf.get(
             "hbm_bw_utilization"

@@ -286,7 +286,8 @@ class EngineMetrics:
                num_slots: int, prefix_cache: dict | None = None,
                kv_cache: dict | None = None,
                structured: dict | None = None,
-               perf: dict | None = None) -> str:
+               perf: dict | None = None,
+               quant: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
@@ -294,7 +295,8 @@ class EngineMetrics:
         layout is active; `structured` is the constraint compiler's info()
         block (mask-cache size gauges); `perf` is its perf_info() block —
         MFU / HBM-bandwidth gauges render when the chip is in the peak-spec
-        table and decode traffic has flowed."""
+        table and decode traffic has flowed; `quant` is its quant_info()
+        block (active int8 mode + honest byte footprints)."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -404,8 +406,31 @@ class EngineMetrics:
                         "llmlb_engine_prefix_cache_pinned_pages "
                         f"{prefix_cache['pinned_pages']}",
                     ]
+            if quant is not None:
+                # info-style gauge: one series per mode, active one = 1, so
+                # dashboards can legend the running quantization mode
+                lines.append("# TYPE llmlb_engine_quant_mode gauge")
+                for mode in ("off", "weights", "kv", "all"):
+                    lines.append(
+                        f'llmlb_engine_quant_mode{{mode="{mode}"}} '
+                        f'{1 if quant.get("mode") == mode else 0}'
+                    )
+                lines += [
+                    "# TYPE llmlb_engine_param_bytes gauge",
+                    f"llmlb_engine_param_bytes {quant.get('param_bytes', 0)}",
+                ]
+            if kv_cache is not None:
+                # honest-dtype KV footprint: renders for BOTH layouts so
+                # capacity dashboards never fall back to implied-bf16 math
+                lines += [
+                    "# TYPE llmlb_engine_kv_hbm_bytes gauge",
+                    f"llmlb_engine_kv_hbm_bytes {kv_cache.get('hbm_bytes', 0)}",
+                ]
             if kv_cache is not None and kv_cache.get("layout") == "paged":
                 lines += [
+                    "# TYPE llmlb_engine_kv_bytes_per_page gauge",
+                    "llmlb_engine_kv_bytes_per_page "
+                    f"{kv_cache.get('bytes_per_page', 0)}",
                     "# TYPE llmlb_engine_kv_pages_total gauge",
                     f"llmlb_engine_kv_pages_total {kv_cache['pages_total']}",
                     "# TYPE llmlb_engine_kv_pages_free gauge",

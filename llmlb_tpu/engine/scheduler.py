@@ -55,6 +55,7 @@ from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
 from llmlb_tpu.ops.sampling import sample_tokens
 from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
+from llmlb_tpu.quant import kv_cell_bytes, parse_quant_mode, quantize_params
 from llmlb_tpu.spec import PromptLookupDrafter, SpecConfig
 from llmlb_tpu.structured.constraint import ConstraintState, TokenConstraint
 
@@ -77,15 +78,26 @@ def kv_cache_bytes(cfg, num_slots: int, slot_capacity: int) -> int:
             * cfg.num_kv_heads * cfg.head_dim_ * 2 * itemsize)
 
 
-def kv_pool_bytes(cfg, num_pages: int, page_size: int) -> int:
-    """HBM footprint of the PAGED KV pool [L, pages, page_size, K, D] ×2
-    (K and V). At the default sizing (num_pages = slots · cap/page_size + 1)
-    this matches the dense footprint within one trash page — the occupancy
-    win comes from admitting MORE slots against the same pool, not from a
-    smaller pool."""
+def kv_page_bytes(cfg, page_size: int, quantized: bool = False) -> int:
+    """HBM bytes ONE page holds across all layers, K and V included. The
+    bf16 cell is D·2 bytes per (token, head); the int8 cell is D·1 plus one
+    f32 scale (llmlb_tpu/quant.kv_cell_bytes) — the per-page figure the
+    kv gauges report so capacity math stays honest under quantization."""
     itemsize = jnp.dtype(cfg.dtype).itemsize
-    return (cfg.num_layers * num_pages * page_size
-            * cfg.num_kv_heads * cfg.head_dim_ * 2 * itemsize)
+    cell = kv_cell_bytes(cfg.head_dim_, quantized, itemsize)
+    return int(cfg.num_layers * page_size * cfg.num_kv_heads * 2 * cell)
+
+
+def kv_pool_bytes(cfg, num_pages: int, page_size: int,
+                  quantized: bool = False) -> int:
+    """HBM footprint of the PAGED KV pool [L, pages, page_size, K, D] ×2
+    (K and V; int8 pools add their f32 scale arrays). At the default sizing
+    (num_pages = slots · cap/page_size + 1) this matches the dense footprint
+    within one trash page — the occupancy win comes from admitting MORE
+    slots against the same pool, not from a smaller pool. Quantized pools
+    hold ~(D+4)/2D of the bf16 bytes per page, so the same HBM budget holds
+    nearly twice the pages."""
+    return num_pages * kv_page_bytes(cfg, page_size, quantized)
 
 
 @partial(jax.jit, donate_argnames=("cache_k", "cache_v"))
@@ -107,16 +119,26 @@ def _scatter_kv_row_paged(cache_k, cache_v, k_all, v_all, table_row):
     prefill's KV [L, 1, T, K, D] in the pool pages named by `table_row`
     [PPN] (positions past the allocated pages hit the trash page — padding
     garbage, same contract as the dense scatter's cells past the valid
-    length)."""
+    length). Quantized pools ({"q","s"} pairs) quantize per vector on the
+    way in, scales landing at the same cells."""
+    from llmlb_tpu.models.llama import kv_pool_values
+    from llmlb_tpu.quant import quantize_kv
+
     t = k_all.shape[2]
-    ps = cache_k.shape[2]
+    ps = kv_pool_values(cache_k).shape[2]
     pos = jnp.arange(t, dtype=jnp.int32)
     page = table_row[jnp.minimum(pos // ps, table_row.shape[0] - 1)]
     off = pos % ps
-    return (
-        cache_k.at[:, page, off].set(k_all[:, 0].astype(cache_k.dtype)),
-        cache_v.at[:, page, off].set(v_all[:, 0].astype(cache_v.dtype)),
-    )
+
+    def scatter(pool, kv_all):
+        kv = kv_all[:, 0]  # [L, T, K, D]
+        if isinstance(pool, dict):
+            q, s = quantize_kv(kv)
+            return {"q": pool["q"].at[:, page, off].set(q),
+                    "s": pool["s"].at[:, page, off].set(s)}
+        return pool.at[:, page, off].set(kv.astype(pool.dtype))
+
+    return scatter(cache_k, k_all), scatter(cache_v, v_all)
 
 
 @partial(jax.jit, donate_argnames=("cache_k", "cache_v"),
@@ -274,6 +296,7 @@ class EngineCore:
         spec_decode: bool | None = None,
         spec_max_draft: int | None = None,
         spec_ngram: int | None = None,
+        quantize: str | None = None,
     ):
         self.cfg = cfg
         # Family module (llama / mixtral) supplying the serving fns — one
@@ -305,6 +328,21 @@ class EngineCore:
             )
             kv_layout = "dense"
         self.kv_layout = kv_layout
+
+        # Int8 quantization (llmlb_tpu/quant, docs/quantization.md): two
+        # independent knobs — per-output-channel int8 projection weights
+        # and int8 KV pages — resolved from `--quantize`/LLMLB_QUANTIZE.
+        # OFF by default; with both knobs off every path below is the
+        # pre-quantization engine bit for bit (tier-1 guarded).
+        self.quant = parse_quant_mode(quantize)
+        if self.quant.kv and self.kv_layout != "paged":
+            log.warning(
+                "int8 KV quantization requires the paged layout; the dense "
+                "slot cache stays bf16 (weights quantization, if requested, "
+                "still applies)"
+            )
+            self.quant = dataclasses.replace(self.quant, kv=False)
+
         # Page size: TPU-friendly default of 128 tokens (one flash block),
         # clamped into the slot capacity. docs/kv-cache.md discusses the
         # waste-vs-overhead tradeoff of other sizes.
@@ -381,10 +419,24 @@ class EngineCore:
 
         if params is None:
             params = self.family.init_params(cfg, jax.random.PRNGKey(seed))
+        if self.quant.weights:
+            # Idempotent: checkpoints quantized at load time (streaming,
+            # engine/weights.py) pass through; random-init / caller-supplied
+            # bf16 pytrees quantize here so every construction path serves
+            # the same int8 layout.
+            params = quantize_params(params)
         shardings = self.family.param_shardings(cfg, self.mesh)
         self.params = {
             k: jax.device_put(v, shardings[k]) for k, v in params.items()
         }
+        if self.quant.weights:
+            log.info(
+                "weights: int8 per-output-channel (%d quantized leaves), "
+                "%.2f GiB on device",
+                sum(1 for k in self.params if k.endswith("_scale")),
+                sum(v.size * v.dtype.itemsize
+                    for v in self.params.values()) / 2**30,
+            )
 
         # Paged-mode host state: the page allocator, per-slot page lists, and
         # the block tables (host numpy mirror + device array refreshed before
@@ -426,17 +478,21 @@ class EngineCore:
                 )
             self.page_pool = PagePool(self.kv_num_pages)
             ck, cv = self.family.init_kv_pages(cfg, self.kv_num_pages,
-                                               self.kv_page_size)
-            ck_sh, cv_sh = self.family.kv_pages_shardings(cfg, self.mesh)
+                                               self.kv_page_size,
+                                               quantized=self.quant.kv)
+            ck_sh, cv_sh = self.family.kv_pages_shardings(
+                cfg, self.mesh, quantized=self.quant.kv
+            )
             self.cache_k = jax.device_put(ck, ck_sh)
             self.cache_v = jax.device_put(cv, cv_sh)
             log.info(
-                "KV cache: paged, %d pages x %d tokens (%d slots, %d "
+                "KV cache: paged%s, %d pages x %d tokens (%d slots, %d "
                 "pages/slot) = %.2f GiB in HBM",
+                " int8" if self.quant.kv else "",
                 self.kv_num_pages, self.kv_page_size, num_slots,
                 self.pages_per_slot,
-                kv_pool_bytes(cfg, self.kv_num_pages,
-                              self.kv_page_size) / 2**30,
+                kv_pool_bytes(cfg, self.kv_num_pages, self.kv_page_size,
+                              quantized=self.quant.kv) / 2**30,
             )
         else:
             ck, cv = self.family.init_kv_cache(cfg, num_slots,
@@ -616,8 +672,18 @@ class EngineCore:
         # step record absorbs it (admission happens between dispatches)
         self._pending_plan_s = 0.0
         # static per-token cost base for perf_info(): parameter count of the
-        # served model (device arrays are cheap to .size)
-        self.n_params = sum(int(v.size) for v in self.params.values())
+        # served model (device arrays are cheap to .size). Scale leaves are
+        # bookkeeping, not parameters — excluded from the FLOP count; the
+        # measured byte footprint (param_bytes) includes them so the HBM
+        # accounting stays honest under int8 weights.
+        self.n_params = sum(
+            int(v.size) for k, v in self.params.items()
+            if not k.endswith("_scale")
+        )
+        self.param_bytes = sum(
+            int(v.size) * jnp.dtype(v.dtype).itemsize
+            for v in self.params.values()
+        )
         self._running = False
         self._thread: threading.Thread | None = None
         self._started_at = time.monotonic()
@@ -658,11 +724,14 @@ class EngineCore:
 
         param_shapes = {k: sharded(v) for k, v in self.params.items()}
         paged = self.page_pool is not None
+        # the caches may be quantized {"q","s"} pytrees — map per leaf
+        cache_k_shapes = jax.tree.map(sharded, self.cache_k)
+        cache_v_shapes = jax.tree.map(sharded, self.cache_v)
         args = [
             param_shapes,
             plain(self._d_last_tokens),
             plain(self._d_seq_lens),
-            sharded(self.cache_k), sharded(self.cache_v),
+            cache_k_shapes, cache_v_shapes,
         ]
         if paged:
             args.append(plain(self._d_block_tables))
@@ -680,16 +749,16 @@ class EngineCore:
                 elif paged:
                     self.family.decode_step_paged.lower(
                         param_shapes, self.cfg, plain(self._d_last_tokens),
-                        plain(self._d_seq_lens), sharded(self.cache_k),
-                        sharded(self.cache_v), plain(self._d_block_tables),
+                        plain(self._d_seq_lens), cache_k_shapes,
+                        cache_v_shapes, plain(self._d_block_tables),
                         self.mesh, window=w,
                     ).compile()
                 else:
                     # single-step mode compiles decode_step per window too
                     self.family.decode_step.lower(
                         param_shapes, self.cfg, plain(self._d_last_tokens),
-                        plain(self._d_seq_lens), sharded(self.cache_k),
-                        sharded(self.cache_v), self.mesh, window=w,
+                        plain(self._d_seq_lens), cache_k_shapes,
+                        cache_v_shapes, self.mesh, window=w,
                     ).compile()
             except Exception:  # pragma: no cover - best-effort warmup
                 log.exception("window %d prewarm failed (will compile "
@@ -903,8 +972,11 @@ class EngineCore:
     def _reset_caches(self) -> None:
         if self.page_pool is not None:
             ck, cv = self.family.init_kv_pages(self.cfg, self.kv_num_pages,
-                                               self.kv_page_size)
-            ck_sh, cv_sh = self.family.kv_pages_shardings(self.cfg, self.mesh)
+                                               self.kv_page_size,
+                                               quantized=self.quant.kv)
+            ck_sh, cv_sh = self.family.kv_pages_shardings(
+                self.cfg, self.mesh, quantized=self.quant.kv
+            )
             # every page mapping is void with the rebuilt pool
             self.page_pool.reset()
             self._slot_pages = [[] for _ in range(self.num_slots)]
@@ -1783,7 +1855,8 @@ class EngineCore:
             info["pinned_pages"] = self._prefix_pinned_pages
             info["pinned_hbm_bytes"] = (
                 self._prefix_pinned_pages
-                * kv_pool_bytes(self.cfg, 1, self.kv_page_size)
+                * kv_page_bytes(self.cfg, self.kv_page_size,
+                                quantized=self.quant.kv)
             )
         else:
             # a pinned donor holds its whole slot row out of the serving pool
@@ -1810,6 +1883,7 @@ class EngineCore:
         if self.page_pool is None:
             return {
                 "layout": "dense",
+                "kv_dtype": str(jnp.dtype(self.cfg.dtype)),
                 "num_slots": self.num_slots,
                 "slot_capacity": self.slot_capacity,
                 "hbm_bytes": kv_cache_bytes(self.cfg, self.num_slots,
@@ -1829,6 +1903,11 @@ class EngineCore:
             waste += max(0, held * self.kv_page_size - used)
         return {
             "layout": "paged",
+            # derived from the ACTUAL pool dtype — implied-bf16 accounting
+            # would be 2x wrong under int8 (the gauges below feed capacity
+            # planning and the Grafana KV panels)
+            "kv_dtype": ("int8" if self.quant.kv
+                         else str(jnp.dtype(self.cfg.dtype))),
             "page_size": self.kv_page_size,
             "num_slots": self.num_slots,
             "slot_capacity": self.slot_capacity,
@@ -1843,8 +1922,25 @@ class EngineCore:
                 waste / max(1, active_pages * self.kv_page_size), 4
             ),
             "waste_tokens_mean": (round(waste / active, 1) if active else 0.0),
+            "bytes_per_page": kv_page_bytes(self.cfg, self.kv_page_size,
+                                            quantized=self.quant.kv),
             "hbm_bytes": kv_pool_bytes(self.cfg, self.kv_num_pages,
-                                       self.kv_page_size),
+                                       self.kv_page_size,
+                                       quantized=self.quant.kv),
+        }
+
+    def quant_info(self) -> dict:
+        """Quantization block for /api/system, /api/health, and /metrics:
+        the resolved knobs plus the honest byte footprints they produce."""
+        itemsize = jnp.dtype(self.cfg.dtype).itemsize
+        return {
+            "mode": self.quant.mode,
+            "weights_int8": self.quant.weights,
+            "kv_int8": self.quant.kv,
+            "param_bytes": self.param_bytes,
+            "param_bytes_bf16": self.n_params * itemsize,
+            "kv_cell_bytes": kv_cell_bytes(self.cfg.head_dim_,
+                                           self.quant.kv, itemsize),
         }
 
     def perf_info(self) -> dict:
@@ -1876,13 +1972,23 @@ class EngineCore:
         ]
         mean_ctx = (sum(contexts) / len(contexts)) if contexts else 0.0
         batch = max(1, len(contexts))
+        itemsize = jnp.dtype(self.cfg.dtype).itemsize
         flops_tok = model_flops_per_token(self.cfg, self.n_params)
-        bytes_tok = model_bytes_per_token(self.cfg, self.n_params, mean_ctx,
-                                          batch=batch)
+        # quantization-honest byte accounting: the measured param footprint
+        # (int8 values + f32 scales when weights quantize) and the actual
+        # KV cell size (D·1 + 4-byte scale under int8 KV) — the implied
+        # bf16 math would double-count HBM traffic quantization removed
+        bytes_tok = model_bytes_per_token(
+            self.cfg, self.n_params, mean_ctx, batch=batch,
+            weight_bytes=self.param_bytes,
+            kv_cell_bytes=kv_cell_bytes(self.cfg.head_dim_, self.quant.kv,
+                                        itemsize),
+        )
         info = {
             "device_kind": str(kind),
             "n_chips": n_chips,
             "n_params": self.n_params,
+            "quantize": self.quant.mode,
             "flops_per_token": flops_tok,
             "bytes_per_token": round(bytes_tok, 1),
             "mean_context_tokens": round(mean_ctx, 1),
@@ -1895,11 +2001,17 @@ class EngineCore:
             info["chip"] = {
                 "generation": spec.generation,
                 "peak_flops": spec.peak_flops,
+                "peak_flops_int8": spec.int8_flops,
                 "peak_hbm_bw": spec.peak_hbm_bw,
             }
         if info["available"]:
             per_chip = tok_per_s / n_chips
-            info["mfu"] = round(flops_tok * per_chip / spec.peak_flops, 6)
+            # int8-weight engines are judged against the chip's int8 OPS
+            # column — quantized matmuls move int8 operands through the MXU,
+            # and dividing by the bf16 peak would overstate MFU ~2x on
+            # chips with an int8 fast path
+            peak = spec.int8_flops if self.quant.weights else spec.peak_flops
+            info["mfu"] = round(flops_tok * per_chip / peak, 6)
             info["hbm_bw_utilization"] = round(
                 bytes_tok * per_chip / spec.peak_hbm_bw, 6
             )

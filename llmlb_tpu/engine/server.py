@@ -304,7 +304,7 @@ class EngineAPI:
             queue_depth=stats.queued, active_slots=stats.active_slots,
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
             kv_cache=core.kv_cache_info(), structured=core.structured_info(),
-            perf=core.perf_info(),
+            perf=core.perf_info(), quant=core.quant_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -321,6 +321,8 @@ class EngineAPI:
                 # paged mode reports live page-pool utilization; dense mode
                 # the static slot-cache footprint
                 "kv_cache": self.engine.core.kv_cache_info(),
+                # int8 quantization knobs + honest byte footprints
+                "quant": self.engine.core.quant_info(),
                 "structured": self.engine.core.structured_info(),
                 # speculative decoding: config + live acceptance figures
                 "spec": self.engine.core.spec_info(),
@@ -937,6 +939,14 @@ def main(argv: list[str] | None = None) -> None:
              "requests)",
     )
     parser.add_argument(
+        "--quantize", choices=("off", "weights", "kv", "all"), default=None,
+        help="int8 quantization (default off; also via LLMLB_QUANTIZE): "
+             "'weights' = per-output-channel int8 projection matrices, "
+             "'kv' = int8 KV pages + per-vector scales (paged layout only), "
+             "'all' = both — halves the HBM bytes each covers "
+             "(docs/quantization.md); bf16 output is bit-identical when off",
+    )
+    parser.add_argument(
         "--spec-decode", choices=("on", "off"), default=None,
         help="speculative decoding default for requests without their own "
              "'speculative' knob (default off; also via LLMLB_SPEC_DECODE): "
@@ -999,6 +1009,8 @@ def main(argv: list[str] | None = None) -> None:
         extra["kv_page_size"] = max(1, args.kv_page_size)
     if args.kv_pages is not None:
         extra["kv_pages"] = max(2, args.kv_pages)
+    if args.quantize is not None:
+        extra["quantize"] = args.quantize
     if args.spec_decode is not None:
         extra["spec_decode"] = args.spec_decode == "on"
     if args.spec_max_draft is not None:

@@ -25,6 +25,14 @@ from llmlb_tpu.engine.tokenizer import (
 )
 
 
+def _quantize_weights(core_kwargs: dict) -> bool:
+    """Resolve whether the construction path should int8-quantize weights
+    while streaming the checkpoint (same knob the core itself parses)."""
+    from llmlb_tpu.quant import parse_quant_mode
+
+    return parse_quant_mode(core_kwargs.get("quantize")).weights
+
+
 @dataclasses.dataclass
 class StreamDelta:
     text: str = ""
@@ -82,7 +90,10 @@ class Engine:
 
             cfg = load_config(checkpoint_dir, dtype=cfg.dtype)
             tokenizer = HFTokenizer(checkpoint_dir)
-            params = load_checkpoint(checkpoint_dir, cfg)
+            params = load_checkpoint(
+                checkpoint_dir, cfg,
+                quantize_weights=_quantize_weights(core_kwargs),
+            )
         else:
             tokenizer = ByteTokenizer(cfg.vocab_size)
         core = EngineCore(
@@ -98,7 +109,13 @@ class Engine:
 
         cfg = load_config(checkpoint_dir)
         tokenizer = HFTokenizer(checkpoint_dir)
-        params = load_checkpoint(checkpoint_dir, cfg)
+        # int8 weight quantization happens per tensor WHILE streaming the
+        # shards (host RAM and H2D both move the int8 bytes); the core's
+        # own quantize pass is idempotent over the result
+        params = load_checkpoint(
+            checkpoint_dir, cfg,
+            quantize_weights=_quantize_weights(core_kwargs),
+        )
         core = EngineCore(cfg, params, eos_id=tokenizer.eos_id, **core_kwargs)
         core.start()
         return cls(
@@ -327,6 +344,8 @@ class Engine:
             "tpu": device_telemetry(),
             "prefix_cache": self.core.prefix_cache_info(),
             "kv_cache": self.core.kv_cache_info(),
+            # int8 quantization knobs + honest byte footprints
+            "quant": self.core.quant_info(),
             "structured": self.core.structured_info(),
             # speculative decoding config + live acceptance figures
             # (llmlb_tpu/spec, docs/speculative.md)

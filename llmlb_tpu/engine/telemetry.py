@@ -16,24 +16,31 @@ import jax
 
 @dataclasses.dataclass(frozen=True)
 class ChipSpec:
-    """Per-chip peak figures for utilization math (bf16 dense FLOPs and HBM
-    bandwidth). Public spec-sheet numbers; MFU/HBM-utilization gauges divide
-    measured work by these."""
+    """Per-chip peak figures for utilization math (bf16 and int8 dense
+    FLOPs, HBM bandwidth). Public spec-sheet numbers; MFU/HBM-utilization
+    gauges divide measured work by these. `peak_flops_int8` is the OPS
+    figure quantized serving is judged against (v5e/v5p/v6e double their
+    bf16 rate on int8 operands; v4 has no int8 fast path — same figure)."""
 
     generation: str
     peak_flops: float  # bf16 FLOP/s per chip
     peak_hbm_bw: float  # bytes/s per chip
+    peak_flops_int8: float = 0.0  # int8 OP/s per chip (0 -> same as bf16)
+
+    @property
+    def int8_flops(self) -> float:
+        return self.peak_flops_int8 or self.peak_flops
 
 
 # Keyed by a normalized device_kind substring (lowercase, spaces stripped).
 # jax reports e.g. "TPU v4", "TPU v5 lite", "TPU v5p", "TPU v6 lite".
 # Order matters: more specific keys first ("v5p" before "v5").
 CHIP_SPECS: tuple[tuple[str, ChipSpec], ...] = (
-    ("v6lite", ChipSpec("v6e", 918e12, 1.64e12)),
-    ("v6e", ChipSpec("v6e", 918e12, 1.64e12)),
-    ("v5p", ChipSpec("v5p", 459e12, 2.765e12)),
-    ("v5lite", ChipSpec("v5e", 197e12, 0.82e12)),
-    ("v5e", ChipSpec("v5e", 197e12, 0.82e12)),
+    ("v6lite", ChipSpec("v6e", 918e12, 1.64e12, 1836e12)),
+    ("v6e", ChipSpec("v6e", 918e12, 1.64e12, 1836e12)),
+    ("v5p", ChipSpec("v5p", 459e12, 2.765e12, 918e12)),
+    ("v5lite", ChipSpec("v5e", 197e12, 0.82e12, 394e12)),
+    ("v5e", ChipSpec("v5e", 197e12, 0.82e12, 394e12)),
     ("v4", ChipSpec("v4", 275e12, 1.23e12)),
 )
 
@@ -65,18 +72,30 @@ def model_flops_per_token(cfg, n_params: int) -> float:
 
 
 def model_bytes_per_token(cfg, n_params: int, mean_context: float,
-                          batch: int = 1) -> float:
+                          batch: int = 1, *,
+                          weight_bytes: float | None = None,
+                          kv_cell_bytes: float | None = None) -> float:
     """HBM bytes read per decoded token: every weight once per STEP (decode
     is memory-bound; weights dominate and are amortized across the `batch`
     sequences decoded together) plus the KV rows of the sequence's own
-    context (never amortized — each sequence reads its own)."""
+    context (never amortized — each sequence reads its own).
+
+    Quantization overrides (llmlb_tpu/quant): `weight_bytes` is the actual
+    total parameter footprint (int8 values + f32 scales when weights are
+    quantized — the engine passes its measured device-array bytes), and
+    `kv_cell_bytes` the bytes per cached (token, head) cell (D·1 + 4-byte
+    scale under int8 KV vs D·itemsize bf16). Defaults reproduce the
+    unquantized bf16 math exactly."""
     import jax.numpy as jnp
 
     itemsize = jnp.dtype(cfg.dtype).itemsize
-    weight_bytes = n_params * itemsize / max(1, batch)
+    if weight_bytes is None:
+        weight_bytes = n_params * itemsize
+    if kv_cell_bytes is None:
+        kv_cell_bytes = cfg.head_dim_ * itemsize
     kv_bytes = (cfg.num_layers * mean_context * cfg.num_kv_heads
-                * cfg.head_dim_ * 2 * itemsize)
-    return weight_bytes + kv_bytes
+                * kv_cell_bytes * 2)
+    return weight_bytes / max(1, batch) + kv_bytes
 
 
 def device_telemetry() -> dict[str, Any]:

@@ -6,6 +6,14 @@ HF names onto the model pytree, transpose to our [in, out] matmul layout, stack
 layers for `lax.scan`, and `jax.device_put` each leaf with its NamedSharding so
 every host touches only its shard. A C++ mmap reader (native/) accelerates the
 host-side read path; `safetensors.numpy` is the portable fallback.
+
+Loading is STREAMING per tensor: `load_checkpoint` builds one pytree leaf at a
+time (stack → cast → optional int8 quantization → device_put → drop the host
+copy), so peak host RAM is one stacked tensor plus the device arrays instead
+of a full second model-size host copy. With `quantize_weights=True` the big
+projection matrices quantize per output channel BEFORE transfer
+(llmlb_tpu/quant), so the H2D traffic and the device footprint are the int8
+bytes too.
 """
 
 from __future__ import annotations
@@ -18,25 +26,39 @@ import jax
 import numpy as np
 
 from llmlb_tpu.models.llama import LlamaConfig, Params, param_shardings
+from llmlb_tpu.quant import WEIGHT_QUANT_NAMES, quantize_channelwise
 
 TensorGetter = Callable[[str], np.ndarray]
+LeafBuilder = Callable[[TensorGetter], np.ndarray]
 
 
-def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
-    """Map HF llama/qwen2/mistral/mixtral tensor names to our stacked pytree."""
+def _param_builders(cfg: LlamaConfig) -> dict[str, LeafBuilder]:
+    """Per-leaf builder functions (name → fn(get) -> host ndarray) in pytree
+    order. Builders are lazy so the streaming loader materializes exactly one
+    stacked tensor at a time."""
 
-    def stack(fmt: str, transpose: bool) -> np.ndarray:
-        leaves = []
-        for i in range(cfg.num_layers):
-            w = get(fmt.format(i=i))
-            leaves.append(w.T if transpose else w)
-        return np.stack(leaves)
+    def stack(fmt: str, transpose: bool) -> LeafBuilder:
+        def build(get: TensorGetter) -> np.ndarray:
+            leaves = []
+            for i in range(cfg.num_layers):
+                w = get(fmt.format(i=i))
+                leaves.append(w.T if transpose else w)
+            return np.stack(leaves)
+
+        return build
+
+    def single(name: str, transpose: bool = False) -> LeafBuilder:
+        def build(get: TensorGetter) -> np.ndarray:
+            w = get(name)
+            return w.T if transpose else w
+
+        return build
 
     if getattr(cfg, "num_experts", 0) > 1:
-        return _convert_hf_moe(cfg, get, stack)
+        return _moe_param_builders(cfg, stack, single)
 
-    params: dict = {
-        "embed": get("model.embed_tokens.weight"),
+    builders: dict[str, LeafBuilder] = {
+        "embed": single("model.embed_tokens.weight"),
         "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
         "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
         "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
@@ -45,36 +67,41 @@ def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
         "wu": stack("model.layers.{i}.mlp.up_proj.weight", True),
         "wd": stack("model.layers.{i}.mlp.down_proj.weight", True),
         "ln_attn": stack("model.layers.{i}.input_layernorm.weight", False),
-        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight", False),
-        "ln_final": get("model.norm.weight"),
+        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight",
+                        False),
+        "ln_final": single("model.norm.weight"),
     }
     if cfg.attention_bias:
-        params["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
-        params["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
-        params["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", False)
+        builders["bq"] = stack("model.layers.{i}.self_attn.q_proj.bias", False)
+        builders["bk"] = stack("model.layers.{i}.self_attn.k_proj.bias", False)
+        builders["bv"] = stack("model.layers.{i}.self_attn.v_proj.bias", False)
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = get("lm_head.weight").T
-    return params
+        builders["lm_head"] = single("lm_head.weight", True)
+    return builders
 
 
-def _convert_hf_moe(cfg, get: TensorGetter, stack) -> Params:
+def _moe_param_builders(cfg, stack, single) -> dict[str, LeafBuilder]:
     """Mixtral layout: block_sparse_moe.gate + experts.{e}.w1/w3/w2 per layer
     (w1 = gate/silu branch, w3 = up, w2 = down in HF's naming)."""
 
-    def stack_experts(wname: str, transpose: bool) -> np.ndarray:
-        layers = []
-        for i in range(cfg.num_layers):
-            experts = []
-            for e in range(cfg.num_experts):
-                w = get(
-                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{wname}.weight"
-                )
-                experts.append(w.T if transpose else w)
-            layers.append(np.stack(experts))
-        return np.stack(layers)  # [L, E_experts, ...]
+    def stack_experts(wname: str, transpose: bool) -> LeafBuilder:
+        def build(get: TensorGetter) -> np.ndarray:
+            layers = []
+            for i in range(cfg.num_layers):
+                experts = []
+                for e in range(cfg.num_experts):
+                    w = get(
+                        f"model.layers.{i}.block_sparse_moe.experts.{e}"
+                        f".{wname}.weight"
+                    )
+                    experts.append(w.T if transpose else w)
+                layers.append(np.stack(experts))
+            return np.stack(layers)  # [L, E_experts, ...]
 
-    params: dict = {
-        "embed": get("model.embed_tokens.weight"),
+        return build
+
+    builders: dict[str, LeafBuilder] = {
+        "embed": single("model.embed_tokens.weight"),
         "wq": stack("model.layers.{i}.self_attn.q_proj.weight", True),
         "wk": stack("model.layers.{i}.self_attn.k_proj.weight", True),
         "wv": stack("model.layers.{i}.self_attn.v_proj.weight", True),
@@ -84,12 +111,20 @@ def _convert_hf_moe(cfg, get: TensorGetter, stack) -> Params:
         "we_up": stack_experts("w3", True),
         "we_down": stack_experts("w2", True),
         "ln_attn": stack("model.layers.{i}.input_layernorm.weight", False),
-        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight", False),
-        "ln_final": get("model.norm.weight"),
+        "ln_mlp": stack("model.layers.{i}.post_attention_layernorm.weight",
+                        False),
+        "ln_final": single("model.norm.weight"),
     }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = get("lm_head.weight").T
-    return params
+        builders["lm_head"] = single("lm_head.weight", True)
+    return builders
+
+
+def convert_hf_tensors(cfg: LlamaConfig, get: TensorGetter) -> Params:
+    """Map HF llama/qwen2/mistral/mixtral tensor names to our stacked pytree
+    (all leaves materialized at once — tests and tooling; the serving load
+    path streams per tensor via load_checkpoint instead)."""
+    return {name: build(get) for name, build in _param_builders(cfg).items()}
 
 
 def _open_shard(path: str):
@@ -151,18 +186,35 @@ def load_config(model_dir: str, dtype=None) -> LlamaConfig:
     return LlamaConfig.from_hf_config(hf, **kwargs)
 
 
-def load_checkpoint(model_dir: str, cfg: LlamaConfig, mesh=None) -> Params:
-    """Load a HF checkpoint directory into (optionally sharded) device arrays."""
+def load_checkpoint(model_dir: str, cfg: LlamaConfig, mesh=None,
+                    quantize_weights: bool = False) -> Params:
+    """Load a HF checkpoint directory into (optionally sharded) device arrays.
+
+    Streams one pytree leaf at a time: build the stacked host tensor, cast to
+    the serving dtype, quantize it (per-output-channel int8 + f32 scales,
+    when requested and the leaf is a projection matrix), `device_put`, then
+    drop the host copy before touching the next leaf. Peak host RAM is one
+    stacked tensor — not a second full model copy."""
     from llmlb_tpu.models import family_for
 
     get = _safetensors_getter(model_dir)
-    host_params = convert_hf_tensors(cfg, get)
-    if mesh is None:
-        return jax.tree.map(
-            lambda x: jax.numpy.asarray(x, dtype=cfg.dtype), host_params
-        )
-    shardings = family_for(cfg).param_shardings(cfg, mesh)
-    return {
-        name: jax.device_put(np.asarray(v, dtype=np.dtype(cfg.dtype)), shardings[name])
-        for name, v in host_params.items()
-    }
+    shardings = (family_for(cfg).param_shardings(cfg, mesh)
+                 if mesh is not None else None)
+
+    def put(name: str, host: np.ndarray):
+        if shardings is None:
+            return jax.numpy.asarray(host)
+        return jax.device_put(host, shardings[name])
+
+    dtype = np.dtype(cfg.dtype)
+    params: Params = {}
+    for name, build in _param_builders(cfg).items():
+        host = build(get)
+        if quantize_weights and name in WEIGHT_QUANT_NAMES:
+            q, scale = quantize_channelwise(np.asarray(host))
+            params[name] = put(name, q)
+            params[f"{name}_scale"] = put(f"{name}_scale", scale)
+        else:
+            params[name] = put(name, np.asarray(host, dtype=dtype))
+        del host  # streaming contract: one host leaf live at a time
+    return params

@@ -40,8 +40,13 @@ from llmlb_tpu.ops.norms import rms_norm
 from llmlb_tpu.ops.rope import RopeScaling, apply_rope, rope_frequencies
 from llmlb_tpu.parallel.mesh import validate_tp
 from llmlb_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+from llmlb_tpu.quant import quantize_kv
 
 Params = dict[str, Any]
+
+# Int8-quantized projection weights ride the pytree as `<name>` (int8) +
+# `<name>_scale` (f32 per output channel) pairs — llmlb_tpu/quant.
+_SCALE = "_scale"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,6 +170,12 @@ def param_logical_axes(cfg: LlamaConfig) -> dict[str, tuple]:
         axes["bv"] = ("layers", "kv_heads")
     if not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+    # Per-output-channel int8 scales (present only on quantized pytrees;
+    # extra sharding entries for absent leaves are never consulted). A
+    # scale's axes are its weight's with the input (contraction) axis
+    # dropped — the scale is per OUTPUT channel.
+    for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
+        axes[name + _SCALE] = (axes[name][0], axes[name][2])
     return axes
 
 
@@ -212,27 +223,81 @@ def kv_cache_shardings(cfg: LlamaConfig, mesh: Mesh, rules: ShardingRules | None
 # ---------------------------------------------------------------------------
 
 def init_kv_pages(
-    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None,
+    quantized: bool = False,
+):
     """Global page pool shared by every slot: a slot's logical row is the
     concatenation of the pool pages its block table names. Page 0 is the
-    engine's trash page (see engine/paging.py)."""
+    engine's trash page (see engine/paging.py).
+
+    `quantized` swaps each pool for an int8 layout: values [L, P, PS, K, D]
+    int8 plus per-vector scales [L, P, PS, K] f32 riding the same page ids
+    (one absmax scale per written (token, head) K/V vector). The pair
+    travels as a {"q", "s"} pytree through the same serving signatures —
+    every alloc/free/refcount/block-table decision stays byte-identical."""
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
              cfg.head_dim_)
+    if quantized:
+        def pool():
+            return {"q": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:-1], jnp.float32)}
+
+        return pool(), pool()
     dtype = dtype or cfg.dtype
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def kv_pages_shardings(cfg: LlamaConfig, mesh: Mesh,
-                       rules: ShardingRules | None = None):
+                       rules: ShardingRules | None = None,
+                       quantized: bool = False):
     """Pages are shared across slots, so the page axis cannot shard over dp
     the way dense slots do (one sequence's pages must stay co-resident);
-    only the kv-head axis splits (tp), pages replicate over dp."""
+    only the kv-head axis splits (tp), pages replicate over dp. Quantized
+    pools shard their scale arrays along the same axes minus head_dim."""
     rules = rules or shard_rules_for(cfg, mesh.shape["tp"])
     sharding = logical_to_sharding(
         mesh, rules, "layers", None, "seq", "kv_heads", "head_dim"
     )
+    if quantized:
+        scale_sh = logical_to_sharding(
+            mesh, rules, "layers", None, "seq", "kv_heads"
+        )
+        pool_sh = {"q": sharding, "s": scale_sh}
+        return (pool_sh, dict(pool_sh))
     return (sharding, sharding)
+
+
+def kv_pool_values(pool):
+    """The value array of a KV page pool (the int8 member of a quantized
+    {"q","s"} pair, or the pool itself when bf16)."""
+    return pool["q"] if isinstance(pool, dict) else pool
+
+
+def _write_pool(pool, page, off, kv):
+    """Scatter K/V rows into pool cells [page, off] (leading layer axis
+    already sliced away). Quantized pools take the int8 values plus the
+    per-vector scales at the same indices — quantize-on-write."""
+    if isinstance(pool, dict):
+        q, s = quantize_kv(kv)
+        return {"q": pool["q"].at[page, off].set(q),
+                "s": pool["s"].at[page, off].set(s)}
+    return pool.at[page, off].set(kv.astype(pool.dtype))
+
+
+def _write_pool_layer(pool, layer_idx, page, off, kv):
+    """Decode-path scatter at a static layer index of the full pool."""
+    if isinstance(pool, dict):
+        q, s = quantize_kv(kv)
+        return {"q": pool["q"].at[layer_idx, page, off].set(q),
+                "s": pool["s"].at[layer_idx, page, off].set(s)}
+    return pool.at[layer_idx, page, off].set(kv.astype(pool.dtype))
+
+
+def _pool_layer(pool, layer_idx):
+    """One layer's slice of the pool (both members when quantized)."""
+    if isinstance(pool, dict):
+        return {"q": pool["q"][layer_idx], "s": pool["s"][layer_idx]}
+    return pool[layer_idx]
 
 
 def make_write_kv_pages(block_tables: jnp.ndarray, page_size: int):
@@ -244,7 +309,7 @@ def make_write_kv_pages(block_tables: jnp.ndarray, page_size: int):
     def write_kv(pool, kv, positions):
         page = jnp.take_along_axis(block_tables, positions // page_size,
                                    axis=1)  # [B, T]
-        return pool.at[page, positions % page_size].set(kv)
+        return _write_pool(pool, page, positions % page_size, kv)
 
     return write_kv
 
@@ -260,12 +325,41 @@ def _layer_stacked_names(cfg: LlamaConfig) -> list[str]:
     return names
 
 
+def _with_scales(params: Params, names: list[str]) -> list[str]:
+    """Extend a stacked-name list with the `<name>_scale` companions a
+    quantized pytree carries, so every per-layer slice sees its scales.
+    On an unquantized pytree this is the identity — same names, same jit
+    cache keys, bit-identical programs."""
+    return list(names) + [n + _SCALE for n in names if n + _SCALE in params]
+
+
+def _proj(lp: Params, name: str, x: jnp.ndarray) -> jnp.ndarray:
+    """`x @ W` with on-the-fly int8 dequant when W is quantized: the int8
+    -> bf16 convert fuses into the einsum's operand read (HBM moves int8
+    bytes), accumulation is fp32 (`preferred_element_type`), and the
+    per-output-channel scale applies to the OUTPUT — exact, because the
+    scale is constant along the contraction axis. Unquantized weights take
+    the original matmul untouched."""
+    w = lp[name]
+    scale = lp.get(name + _SCALE)
+    if scale is None:
+        if w.dtype == jnp.int8:
+            raise TypeError(
+                f"param {name!r} is int8 but its {name}{_SCALE} companion "
+                "is missing from the layer slice"
+            )
+        return x @ w
+    y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    return (y * scale).astype(x.dtype)
+
+
 def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray):
     b, t, _ = x.shape
     d = cfg.head_dim_
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = _proj(lp, "wq", x)
+    k = _proj(lp, "wk", x)
+    v = _proj(lp, "wv", x)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -278,7 +372,9 @@ def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray):
 
 
 def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    return (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+    return _proj(
+        lp, "wd", jax.nn.silu(_proj(lp, "wg", x)) * _proj(lp, "wu", x)
+    )
 
 
 def _attn_block(cfg: LlamaConfig, lp: Params, x: jnp.ndarray, positions,
@@ -293,7 +389,7 @@ def _attn_block(cfg: LlamaConfig, lp: Params, x: jnp.ndarray, positions,
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     attn = attn_fn(q, k, v)
-    return x + attn.reshape(b, t, -1) @ lp["wo"], k, v
+    return x + _proj(lp, "wo", attn.reshape(b, t, -1)), k, v
 
 
 def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -310,7 +406,8 @@ def _default_mlp_fn(lp: Params, h: jnp.ndarray, token_valid) -> jnp.ndarray:
 
 def _write_kv_fresh(cache, kv, positions):
     """KV write for prefill into fresh per-request slots (rows 0..B)."""
-    return lax.dynamic_update_slice(cache, kv, (0, 0, 0, 0))
+    return lax.dynamic_update_slice(cache, kv.astype(cache.dtype),
+                                    (0, 0, 0, 0))
 
 
 def make_write_kv_slots(slot_ids: jnp.ndarray):
@@ -318,7 +415,9 @@ def make_write_kv_slots(slot_ids: jnp.ndarray):
     live slot cache — the continuous-batching insert path."""
 
     def write_kv(cache, kv, positions):
-        return cache.at[slot_ids[:, None], positions].set(kv)
+        return cache.at[slot_ids[:, None], positions].set(
+            kv.astype(cache.dtype)
+        )
 
     return write_kv
 
@@ -337,7 +436,8 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
     token_valid = positions < prompt_lens[:, None]  # [B, T]
 
     x = params["embed"][input_ids]  # [B, T, E]
-    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+    stacked = {n: params[n] for n in _with_scales(
+        params, stacked_names or _layer_stacked_names(cfg))}
 
     def layer(carry_x, layer_in):
         lp, ck, cv = layer_in
@@ -345,8 +445,8 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
             cfg, lp, carry_x, positions, inv_freq,
             lambda q, k, v: gqa_attention_prefill(q, k, v, prompt_lens),
         )
-        ck = write_kv(ck, k.astype(ck.dtype), positions)
-        cv = write_kv(cv, v.astype(cv.dtype), positions)
+        ck = write_kv(ck, k, positions)
+        cv = write_kv(cv, v, positions)
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
         carry_x = carry_x + mlp_fn(lp, h, token_valid)
         return carry_x, (ck, cv)
@@ -382,7 +482,7 @@ def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
     batch_idx = jnp.arange(b)
 
     x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
-    names = stacked_names or _layer_stacked_names(cfg)
+    names = _with_scales(params, stacked_names or _layer_stacked_names(cfg))
 
     for layer_idx in range(cfg.num_layers):
         lp = {n: params[n][layer_idx] for n in names}
@@ -473,7 +573,8 @@ def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids
     token_valid = offs < chunk_lens[:, None]  # [B, T]
 
     x = params["embed"][input_ids]  # [B, T, E]
-    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+    stacked = {n: params[n] for n in _with_scales(
+        params, stacked_names or _layer_stacked_names(cfg))}
 
     def layer(carry_x, layer_in):
         lp, ck, cv = layer_in
@@ -548,7 +649,7 @@ def prefill_into_pages(
     Returns (last_logits [B, V] fp32, cache_k, cache_v)."""
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
-        make_write_kv_pages(block_tables, cache_k.shape[2]),
+        make_write_kv_pages(block_tables, kv_pool_values(cache_k).shape[2]),
     )
 
 
@@ -567,7 +668,7 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
     from llmlb_tpu.ops.attention import paged_attention_extend
 
     _, t = input_ids.shape
-    ps = cache_k.shape[2]
+    ps = kv_pool_values(cache_k).shape[2]
     ppn = block_tables.shape[1]
     capacity = ppn * ps
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -586,15 +687,16 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
         )
 
     x = params["embed"][input_ids]  # [B, T, E]
-    stacked = {n: params[n] for n in (stacked_names or _layer_stacked_names(cfg))}
+    stacked = {n: params[n] for n in _with_scales(
+        params, stacked_names or _layer_stacked_names(cfg))}
 
     def layer(carry_x, layer_in):
         lp, ck, cv = layer_in
 
         def attn_fn(q, k, v):
             nonlocal ck, cv  # pool write precedes attention over the pool
-            ck = ck.at[page, off].set(k.astype(ck.dtype))
-            cv = cv.at[page, off].set(v.astype(cv.dtype))
+            ck = _write_pool(ck, page, off, k)
+            cv = _write_pool(cv, page, off, v)
             return paged_attention_extend(
                 q, ck, cv, read_tables, positions, chunk_lens
             )
@@ -699,7 +801,7 @@ def _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
     from llmlb_tpu.ops.attention import paged_attention_decode
 
     b = input_ids.shape[0]
-    ps = cache_k.shape[2]
+    ps = kv_pool_values(cache_k).shape[2]
     capacity = block_tables.shape[1] * ps
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     write_pos = jnp.minimum(seq_lens, capacity - 1)
@@ -709,21 +811,20 @@ def _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
     off = write_pos % ps
 
     x = params["embed"][input_ids][:, None, :]  # [B, 1, E]
-    names = stacked_names or _layer_stacked_names(cfg)
+    names = _with_scales(params, stacked_names or _layer_stacked_names(cfg))
 
     for layer_idx in range(cfg.num_layers):
         lp = {n: params[n][layer_idx] for n in names}
 
         def attn_fn(q, k, v, layer_idx=layer_idx):
             nonlocal cache_k, cache_v  # write precedes attention over the pool
-            cache_k = cache_k.at[layer_idx, page, off].set(
-                k[:, 0].astype(cache_k.dtype)
-            )
-            cache_v = cache_v.at[layer_idx, page, off].set(
-                v[:, 0].astype(cache_v.dtype)
-            )
+            cache_k = _write_pool_layer(cache_k, layer_idx, page, off,
+                                        k[:, 0])
+            cache_v = _write_pool_layer(cache_v, layer_idx, page, off,
+                                        v[:, 0])
             return paged_attention_decode(
-                q, cache_k[layer_idx], cache_v[layer_idx], block_tables,
+                q, _pool_layer(cache_k, layer_idx),
+                _pool_layer(cache_v, layer_idx), block_tables,
                 write_pos + 1, window=window,
             )
 
@@ -775,7 +876,8 @@ def encode(
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
 
     x = params["embed"][input_ids]
-    stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+    stacked = {n: params[n]
+               for n in _with_scales(params, _layer_stacked_names(cfg))}
 
     def layer(carry_x, lp):
         carry_x, _, _ = _attn_block(
@@ -830,7 +932,8 @@ def make_context_parallel_prefill(cfg: LlamaConfig, mesh: Mesh):
 
         x = params["embed"][input_ids]  # [B, T, E]
         x = lax.with_sharding_constraint(x, seq_spec)
-        stacked = {n: params[n] for n in _layer_stacked_names(cfg)}
+        stacked = {n: params[n]
+                   for n in _with_scales(params, _layer_stacked_names(cfg))}
 
         def layer(carry_x, lp):
             carry_x, k, v = _attn_block(

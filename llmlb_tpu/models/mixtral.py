@@ -29,6 +29,7 @@ from llmlb_tpu.models.llama import (
     _prefill_extend_paged_impl,
     _prefill_impl,
     _write_kv_fresh,
+    kv_pool_values,
     make_write_kv_pages,
     make_write_kv_slots,
 )
@@ -103,6 +104,14 @@ def param_logical_axes(cfg: MixtralConfig) -> dict[str, tuple]:
     }
     if not cfg.tie_word_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+    # Int8 per-output-channel scales (llmlb_tpu/quant): the weight's axes
+    # with the input (contraction) axis dropped. Extra entries for absent
+    # leaves are never consulted.
+    for name in ("wq", "wk", "wv", "wo"):
+        axes[name + "_scale"] = (axes[name][0], axes[name][2])
+    for name in ("we_gate", "we_up", "we_down"):
+        w_axes = axes[name]
+        axes[name + "_scale"] = (w_axes[0], w_axes[1], w_axes[3])
     return axes
 
 
@@ -150,10 +159,16 @@ def _moe_mlp(cfg: MixtralConfig, lp: Params, x: jnp.ndarray, mesh: Mesh | None,
     s = b * t
     flat = x.reshape(s, m)
     logits = flat @ lp["router"]
+    # int8 expert weights carry per-output-channel scales (llmlb_tpu/quant);
+    # absent on bf16 pytrees, in which case the original einsums run.
+    scales = {
+        f"w_{k}_scale": lp.get(f"we_{k}_scale")
+        for k in ("gate", "up", "down")
+    }
     if exact:
         out = moe_dense_exact(
             flat, logits, lp["we_gate"], lp["we_up"], lp["we_down"],
-            num_selected=cfg.experts_per_token, mesh=mesh,
+            num_selected=cfg.experts_per_token, mesh=mesh, **scales,
         )
     else:
         cap = default_capacity(
@@ -163,6 +178,7 @@ def _moe_mlp(cfg: MixtralConfig, lp: Params, x: jnp.ndarray, mesh: Mesh | None,
             flat, logits, lp["we_gate"], lp["we_up"], lp["we_down"],
             num_selected=cfg.experts_per_token, capacity=cap, mesh=mesh,
             token_valid=None if token_valid is None else token_valid.reshape(s),
+            **scales,
         )
     return out.reshape(b, t, m)
 
@@ -245,7 +261,7 @@ def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
     b, t = input_ids.shape
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
-        make_write_kv_pages(block_tables, cache_k.shape[2]),
+        make_write_kv_pages(block_tables, kv_pool_values(cache_k).shape[2]),
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
     )

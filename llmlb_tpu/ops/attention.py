@@ -127,21 +127,39 @@ def gqa_attention_extend(
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
-def gather_kv_pages(pages: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+def gather_kv_pages(pages, tables: jnp.ndarray,
+                    dtype=jnp.bfloat16) -> jnp.ndarray:
     """Materialize contiguous per-row KV from the page pool: [P, PS, K, D]
     gathered by block tables [B, N] -> [B, N*PS, K, D]. This is the XLA
     fallback path (CPU tests / partitioned meshes) — on an unpartitioned TPU
     the Pallas paged kernels index the pool through the block table instead
-    and never build this copy."""
+    and never build this copy.
+
+    An int8 pool arrives as a {"q": int8 values, "s": f32 scales [P, PS, K]}
+    pair (llmlb_tpu/quant): both gather through the same table and the cells
+    dequantize to `dtype` here — the attention callers pass their compute
+    dtype so this route matches the Pallas quant kernels' numerics exactly
+    (f32 dequant -> q.dtype operands). HBM moved the int8 bytes + scales."""
+    if isinstance(pages, dict):
+        b, n = tables.shape
+        _, ps, k, d = pages["q"].shape
+        vals = pages["q"][tables].reshape(b, n * ps, k, d)
+        scales = pages["s"][tables].reshape(b, n * ps, k)
+        return (vals.astype(jnp.float32)
+                * scales[..., None]).astype(dtype)
     b, n = tables.shape
     _, ps, k, d = pages.shape
     return pages[tables].reshape(b, n * ps, k, d)
 
 
+def _pool_shape(pages):
+    return (pages["q"] if isinstance(pages, dict) else pages).shape
+
+
 def paged_attention_decode(
     q: jnp.ndarray,  # [B, 1, H, D]
-    k_pages: jnp.ndarray,  # [P, PS, K, D] — global page pool
-    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    k_pages,  # [P, PS, K, D] pool, or quantized {"q","s"} pair
+    v_pages,  # [P, PS, K, D]
     block_tables: jnp.ndarray,  # [B, PPN] int32
     kv_lens: jnp.ndarray,  # [B] int32 — valid logical length per row
     window: int | None = None,  # static: read only the first `window` cells
@@ -150,25 +168,32 @@ def paged_attention_decode(
     as gqa_attention_decode — `window` (STATIC) bounds the logical sweep,
     rounded up to whole pages; rows with kv_lens beyond the swept pages
     produce garbage the caller must discard (parked/freed slot rows)."""
-    ps = k_pages.shape[1]
+    ps = _pool_shape(k_pages)[1]
     ppn = block_tables.shape[1]
     pages = ppn if window is None else max(1, min(ppn, -(-window // ps)))
     if _pallas_enabled():
+        if isinstance(k_pages, dict):
+            from llmlb_tpu.ops.pallas_attention import paged_flash_decode_quant
+
+            return paged_flash_decode_quant(
+                q[:, 0], k_pages["q"], k_pages["s"], v_pages["q"],
+                v_pages["s"], block_tables, kv_lens, pages=pages,
+            )[:, None]
         from llmlb_tpu.ops.pallas_attention import paged_flash_decode
 
         return paged_flash_decode(
             q[:, 0], k_pages, v_pages, block_tables, kv_lens, pages=pages
         )[:, None]
     tables = block_tables[:, :pages] if pages < ppn else block_tables
-    k_cache = gather_kv_pages(k_pages, tables)
-    v_cache = gather_kv_pages(v_pages, tables)
+    k_cache = gather_kv_pages(k_pages, tables, dtype=q.dtype)
+    v_cache = gather_kv_pages(v_pages, tables, dtype=q.dtype)
     return gqa_attention_decode(q, k_cache, v_cache, kv_lens)
 
 
 def paged_attention_extend(
     q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
-    k_pages: jnp.ndarray,  # [P, PS, K, D]
-    v_pages: jnp.ndarray,  # [P, PS, K, D]
+    k_pages,  # [P, PS, K, D] pool, or quantized {"q","s"} pair
+    v_pages,  # [P, PS, K, D]
     block_tables: jnp.ndarray,  # [B, PPN] int32
     q_positions: jnp.ndarray,  # [B, T] int32 — global position of each query
     chunk_lens: jnp.ndarray,  # [B] int32 — valid queries in the chunk
@@ -178,13 +203,20 @@ def paged_attention_extend(
     chunk). Paged counterpart of gqa_attention_extend; assumes the engine's
     contiguous chunk positions (q_positions[b] = start + iota)."""
     if _pallas_enabled():
+        if isinstance(k_pages, dict):
+            from llmlb_tpu.ops.pallas_attention import paged_flash_extend_quant
+
+            return paged_flash_extend_quant(
+                q, k_pages["q"], k_pages["s"], v_pages["q"], v_pages["s"],
+                block_tables, q_positions[:, 0], chunk_lens,
+            )
         from llmlb_tpu.ops.pallas_attention import paged_flash_extend
 
         return paged_flash_extend(
             q, k_pages, v_pages, block_tables, q_positions[:, 0], chunk_lens
         )
-    k_cache = gather_kv_pages(k_pages, block_tables)
-    v_cache = gather_kv_pages(v_pages, block_tables)
+    k_cache = gather_kv_pages(k_pages, block_tables, dtype=q.dtype)
+    v_cache = gather_kv_pages(v_pages, block_tables, dtype=q.dtype)
     # chunk_lens=None pins gqa_attention_extend to the XLA einsum path (the
     # caches are already materialized dense here).
     return gqa_attention_extend(q, k_cache, v_cache, q_positions, None)

@@ -26,6 +26,20 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _expert_mm(eq: str, a: jnp.ndarray, w: jnp.ndarray,
+               scale: jnp.ndarray | None) -> jnp.ndarray:
+    """Per-expert einsum with optional int8 dequant (llmlb_tpu/quant): the
+    int8 -> compute-dtype convert fuses into the operand read, and the
+    per-output-channel scale [E, out] applies to the f32 OUTPUT — exact,
+    since the scale is constant along the contraction. Unquantized weights
+    run the original einsum untouched. Returns f32 (caller casts)."""
+    if scale is None:
+        return jnp.einsum(eq, a, w, preferred_element_type=jnp.float32)
+    y = jnp.einsum(eq, a, w.astype(a.dtype),
+                   preferred_element_type=jnp.float32)
+    return y * scale[:, None, :]
+
+
 def top_k_routing(
     router_logits: jnp.ndarray,  # [S, E] fp32
     num_selected: int,
@@ -49,6 +63,9 @@ def moe_dispatch_combine(
     mesh: Mesh | None = None,
     ep_axis: str = "ep",
     token_valid: jnp.ndarray | None = None,  # [S] bool — False = padding
+    w_gate_scale: jnp.ndarray | None = None,  # [E, F] int8 dequant scales
+    w_up_scale: jnp.ndarray | None = None,  # [E, F]
+    w_down_scale: jnp.ndarray | None = None,  # [E, M]
 ) -> jnp.ndarray:
     """SwiGLU expert MLPs with top-k dispatch. Returns [S, M].
 
@@ -91,12 +108,12 @@ def moe_dispatch_combine(
 
     # Per-expert SwiGLU, batched over the (ep-sharded) expert dim.
     h = jax.nn.silu(
-        jnp.einsum("ecm,emf->ecf", expert_in, w_gate,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    ) * jnp.einsum("ecm,emf->ecf", expert_in, w_up,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    expert_out = jnp.einsum(
-        "ecf,efm->ecm", h, w_down, preferred_element_type=jnp.float32
+        _expert_mm("ecm,emf->ecf", expert_in, w_gate,
+                   w_gate_scale).astype(x.dtype)
+    ) * _expert_mm("ecm,emf->ecf", expert_in, w_up,
+                   w_up_scale).astype(x.dtype)
+    expert_out = _expert_mm(
+        "ecf,efm->ecm", h, w_down, w_down_scale
     ).astype(x.dtype)
     if mesh is not None and ep_axis in mesh.axis_names:
         expert_out = lax.with_sharding_constraint(
@@ -119,6 +136,9 @@ def moe_dense_exact(
     num_selected: int,
     mesh: Mesh | None = None,
     ep_axis: str = "ep",
+    w_gate_scale: jnp.ndarray | None = None,  # [E, F] int8 dequant scales
+    w_up_scale: jnp.ndarray | None = None,  # [E, F]
+    w_down_scale: jnp.ndarray | None = None,  # [E, M]
 ) -> jnp.ndarray:
     """Exact top-k MoE: every expert runs on every token, combine masks the
     rest. E/k × the routed FLOPs — the right trade for *decode*, where S is a
@@ -134,12 +154,10 @@ def moe_dense_exact(
                * weights[..., None]).sum(axis=1)
 
     h = jax.nn.silu(
-        jnp.einsum("sm,emf->esf", x, w_gate,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    ) * jnp.einsum("sm,emf->esf", x, w_up,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
-    expert_out = jnp.einsum(
-        "esf,efm->esm", h, w_down, preferred_element_type=jnp.float32
+        _expert_mm("sm,emf->esf", x, w_gate, w_gate_scale).astype(x.dtype)
+    ) * _expert_mm("sm,emf->esf", x, w_up, w_up_scale).astype(x.dtype)
+    expert_out = _expert_mm(
+        "esf,efm->esm", h, w_down, w_down_scale
     )  # [E, S, M] fp32
     if mesh is not None and ep_axis in mesh.axis_names:
         expert_out = lax.with_sharding_constraint(

@@ -282,6 +282,141 @@ def paged_flash_decode(
 
 
 # ---------------------------------------------------------------------------
+# Quantized paged decode: int8 page pool + per-vector f32 scales. The scale
+# arrays [P, PS, K] ride the SAME block-table prefetch as the values (their
+# BlockSpec index_map picks the identical pool page per grid step), and each
+# KV vector dequantizes in VMEM right before its dot — HBM moved int8 bytes.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_quant_kernel(
+    block_tables_ref,  # consumed by the index maps
+    kv_lens_ref,  # [B] int32 (SMEM)
+    q_ref,  # [1, K, G, D]
+    k_ref,  # [1, PS, K, D] int8
+    ks_ref,  # [1, PS, K] f32
+    v_ref,  # [1, PS, K, D] int8
+    vs_ref,  # [1, PS, K] f32
+    o_ref,  # [1, K, G, D]
+    m_ref, l_ref, acc_ref,
+    *,
+    block_k: int,
+    num_kv: int,
+    scale: float,
+):
+    del block_tables_ref
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+    kv_len = kv_lens_ref[b]
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(s * block_k < kv_len)
+    def _compute():
+        col = s * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), dimension=1
+        )
+        valid = col < kv_len  # [1, BLK]
+        for h in range(num_kv):  # static unroll over KV heads
+            q = q_ref[0, h]  # [G, D]
+            k = (k_ref[0, :, h, :].astype(jnp.float32)
+                 * ks_ref[0, :, h][:, None]).astype(q.dtype)  # [BLK, D]
+            v = (v_ref[0, :, h, :].astype(jnp.float32)
+                 * vs_ref[0, :, h][:, None]).astype(q.dtype)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, BLK]
+            scores = jnp.where(valid, scores, _NEG_INF)
+            _online_update(m_ref, l_ref, acc_ref, h, scores, v)
+
+    @pl.when(s == num_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("pages", "interpret"))
+def paged_flash_decode_quant(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [P, PS, K, D] int8
+    k_scales: jnp.ndarray,  # [P, PS, K] f32 — per written K vector
+    v_pages: jnp.ndarray,  # [P, PS, K, D] int8
+    v_scales: jnp.ndarray,  # [P, PS, K] f32
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    kv_lens: jnp.ndarray,  # [B] int32
+    *,
+    pages: int | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Int8 variant of paged_flash_decode: dequant-on-read inside the
+    kernel. Same grid/garbage contract; numerics match the XLA dequant
+    fallback (f32 dequant -> q.dtype operands -> f32 accumulation)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    num_kv = k_pages.shape[2]
+    g = h // num_kv
+    ppn = block_tables.shape[1]
+    sweep = ppn if pages is None else max(1, min(pages, ppn))
+    qg = q.reshape(b, num_kv, g, d)
+
+    def page_map(bi, si, tables, lens):
+        return (tables[bi, si], 0, 0, 0)
+
+    def scale_map(bi, si, tables, lens):
+        return (tables[bi, si], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, sweep),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_kv, g, d),
+                lambda bi, si, tables, lens: (bi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, ps, num_kv, d), page_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv), scale_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv, d), page_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv), scale_map,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_kv, g, d),
+            lambda bi, si, tables, lens: (bi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_quant_kernel, block_k=ps, num_kv=num_kv,
+            scale=d**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qg, k_pages, k_scales, v_pages, v_scales)
+    return out.reshape(b, h, d)
+
+
+# ---------------------------------------------------------------------------
 # Prefill: causal q [B, T, H, D] vs fresh k/v [B, T, K, D], ragged prompt_lens
 # ---------------------------------------------------------------------------
 
@@ -669,4 +804,158 @@ def paged_flash_extend(
         interpret=interpret,
     )(block_tables.astype(jnp.int32), start_pos.astype(jnp.int32),
       chunk_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(b, t, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Quantized paged extend: int8 pool + per-vector scales, dequant-on-read —
+# the verify/chunked-prefill counterpart of paged_flash_decode_quant.
+# ---------------------------------------------------------------------------
+
+
+def _paged_extend_quant_kernel(
+    block_tables_ref,  # consumed by the index maps
+    start_pos_ref,  # [B] int32 (SMEM)
+    chunk_lens_ref,  # [B] int32 (SMEM)
+    q_ref,  # [1, BLK_Q, K, G, D]
+    k_ref,  # [1, PS, K, D] int8
+    ks_ref,  # [1, PS, K] f32
+    v_ref,  # [1, PS, K, D] int8
+    vs_ref,  # [1, PS, K] f32
+    o_ref,  # [1, BLK_Q, K, G, D]
+    m_ref, l_ref, acc_ref,
+    *,
+    block_q: int,
+    block_k: int,
+    num_kv: int,
+    groups: int,
+    scale: float,
+):
+    del block_tables_ref
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k_blocks = pl.num_programs(2)
+    start = start_pos_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    rows = block_q * groups
+    useful = jnp.logical_and(
+        k_start <= start + q_start + block_q - 1,
+        q_start < chunk_lens_ref[b],
+    )
+
+    @pl.when(useful)
+    def _compute():
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), dimension=0)
+        col = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), dimension=1
+        )
+        q_pos = start + q_start + row // groups
+        mask = col <= q_pos
+        for h in range(num_kv):  # static unroll over KV heads
+            q = q_ref[0, :, h].reshape(rows, -1)  # [BLK_Q*G, D]
+            k = (k_ref[0, :, h, :].astype(jnp.float32)
+                 * ks_ref[0, :, h][:, None]).astype(q.dtype)  # [BLK_K, D]
+            v = (v_ref[0, :, h, :].astype(jnp.float32)
+                 * vs_ref[0, :, h][:, None]).astype(q.dtype)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            scores = jnp.where(mask, scores, _NEG_INF)
+            _online_update(m_ref, l_ref, acc_ref, h, scores, v)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0] = out.reshape(num_kv, block_q, groups, -1).transpose(1, 0, 2, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def paged_flash_extend_quant(
+    q: jnp.ndarray,  # [B, T, H, D] — chunk of queries
+    k_pages: jnp.ndarray,  # [P, PS, K, D] int8
+    k_scales: jnp.ndarray,  # [P, PS, K] f32
+    v_pages: jnp.ndarray,  # [P, PS, K, D] int8
+    v_scales: jnp.ndarray,  # [P, PS, K] f32
+    block_tables: jnp.ndarray,  # [B, PPN] int32
+    start_pos: jnp.ndarray,  # [B] int32
+    chunk_lens: jnp.ndarray,  # [B] int32
+    *,
+    block_q: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Int8 variant of paged_flash_extend: scales gather through the same
+    prefetched block table and each page's vectors dequantize in VMEM.
+    Same causal/ragged skip logic and garbage contract."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    ps = k_pages.shape[1]
+    num_kv = k_pages.shape[2]
+    g = h // num_kv
+    ppn = block_tables.shape[1]
+    blk_q = min(block_q, t)
+    grid = (b, pl.cdiv(t, blk_q), ppn)
+    qg = q.reshape(b, t, num_kv, g, d)
+
+    def page_map(bi, qi, si, tables, starts, lens):
+        return (tables[bi, si], 0, 0, 0)
+
+    def scale_map(bi, qi, si, tables, starts, lens):
+        return (tables[bi, si], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, blk_q, num_kv, g, d),
+                lambda bi, qi, si, tables, starts, lens: (bi, qi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, ps, num_kv, d), page_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv), scale_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv, d), page_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ps, num_kv), scale_map,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, num_kv, g, d),
+            lambda bi, qi, si, tables, starts, lens: (bi, qi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, 1), jnp.float32),
+            pltpu.VMEM((num_kv, blk_q * g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_extend_quant_kernel,
+            block_q=blk_q,
+            block_k=ps,
+            num_kv=num_kv,
+            groups=g,
+            scale=d**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, t, num_kv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), start_pos.astype(jnp.int32),
+      chunk_lens.astype(jnp.int32), qg, k_pages, k_scales, v_pages, v_scales)
     return out.reshape(b, t, h, d)

@@ -1,0 +1,51 @@
+"""Int8 quantization for weights and KV pages (docs/quantization.md).
+
+Two independent knobs, combined as `--quantize weights|kv|all|off`
+(`LLMLB_QUANTIZE`, default off):
+
+- **weights**: per-output-channel symmetric int8 for the big projection
+  matrices (attention q/k/v/o, MLP gate/up/down, MoE expert weights),
+  stored as `{int8 values, f32 scales}` param pairs. Matmuls dequantize on
+  the fly — the int8 -> bf16 convert fuses into the einsum's operand read,
+  so HBM traffic is the int8 bytes, and the per-channel scale applies to
+  the matmul OUTPUT (scale depends only on the output channel, so
+  `x @ W_q * s == x @ (W_q * s)` exactly in fp32 accumulation).
+- **kv**: int8 KV cache pages. The paged pool becomes
+  `{int8 values [L,P,PS,K,D], f32 scales [L,P,PS,K]}` — one symmetric
+  absmax scale per written K/V vector (per token, per head), quantized on
+  write by every prefill/decode/verify path and dequantized on read by the
+  attention kernels (scales ride the same block-table gather). Page ids,
+  refcounts, block tables, prefix-cache sharing, and spec-decode rollback
+  are untouched: scales are just a second array indexed by the same pages.
+
+Everything here is shape-polymorphic and works on numpy arrays (host-side
+streaming checkpoint quantization in engine/weights.py) and jax arrays
+(in-jit KV write paths) alike. With the knob off nothing in the serving
+path changes — bf16 output is bit-identical (tier-1 guarded).
+"""
+
+from llmlb_tpu.quant.core import (
+    KV_SCALE_DTYPE,
+    WEIGHT_QUANT_NAMES,
+    QuantConfig,
+    dequantize_channelwise,
+    dequantize_kv,
+    kv_cell_bytes,
+    parse_quant_mode,
+    quantize_channelwise,
+    quantize_kv,
+    quantize_params,
+)
+
+__all__ = [
+    "KV_SCALE_DTYPE",
+    "WEIGHT_QUANT_NAMES",
+    "QuantConfig",
+    "dequantize_channelwise",
+    "dequantize_kv",
+    "kv_cell_bytes",
+    "parse_quant_mode",
+    "quantize_channelwise",
+    "quantize_kv",
+    "quantize_params",
+]

@@ -1,0 +1,176 @@
+"""Symmetric int8 quantization primitives (weights + KV vectors).
+
+Scheme (docs/quantization.md): absmax symmetric over a reduction group —
+`scale = max(|x|) / 127`, `q = round(x / scale)` clipped to [-127, 127].
+No zero point: transformer weights and KV activations are near-zero-mean,
+symmetric quantization keeps dequant a single fused multiply, and the MXU
+accumulates the int8->bf16 operands in fp32 either way.
+
+Groups:
+- weights: per OUTPUT channel (reduce over the input axis, axis=-2 of the
+  [..., in, out] matmul layout) — one f32 scale per output column. Error is
+  bounded by scale/2 = absmax/254 per element, and the scale commutes with
+  the contraction so it applies to the matmul output.
+- KV: per written vector (reduce over the head_dim axis, axis=-1) — one
+  f32 scale per (token, kv-head). Finer than per-page scaling on purpose:
+  decode appends one token at a time, and a coarser group would need
+  re-scaling already-written int8 cells when a later token's amplitude
+  grows past the group's absmax.
+
+Implementations are numpy/jax polymorphic: the array module is inferred
+from the input so host-side checkpoint streaming (numpy, engine/weights.py)
+and in-jit KV writes (jax) share one code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+# Param names whose matmul weights quantize (both model families; names
+# absent from a family's pytree are simply skipped). Embeddings, norms,
+# lm_head, router, and biases stay bf16: they are small, and the embed /
+# lm_head tables feed gathers and the fp32 unembed where int8 error is
+# least welcome.
+WEIGHT_QUANT_NAMES = (
+    "wq", "wk", "wv", "wo",          # attention projections
+    "wg", "wu", "wd",                # dense SwiGLU MLP
+    "we_gate", "we_up", "we_down",   # MoE expert FFNs
+)
+
+SCALE_SUFFIX = "_scale"
+KV_SCALE_DTYPE = np.float32
+_QMAX = 127.0
+_EPS = 1e-8  # all-zero groups quantize to zeros with a harmless tiny scale
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """Resolved quantization knobs for one engine."""
+
+    weights: bool = False
+    kv: bool = False
+
+    @property
+    def mode(self) -> str:
+        if self.weights and self.kv:
+            return "all"
+        if self.weights:
+            return "weights"
+        if self.kv:
+            return "kv"
+        return "off"
+
+    @property
+    def enabled(self) -> bool:
+        return self.weights or self.kv
+
+
+def parse_quant_mode(mode: str | None = None) -> QuantConfig:
+    """Resolve `--quantize` / LLMLB_QUANTIZE into a QuantConfig.
+
+    Accepts off|weights|kv|all (case-insensitive; "0"/"false"/"none" alias
+    off). Raises ValueError for anything else — a typo'd mode must not
+    silently serve bf16 while the operator believes HBM halved."""
+    if mode is None:
+        mode = os.environ.get("LLMLB_QUANTIZE", "off")
+    key = str(mode).strip().lower()
+    if key in ("off", "0", "false", "none", ""):
+        return QuantConfig()
+    if key == "weights":
+        return QuantConfig(weights=True)
+    if key == "kv":
+        return QuantConfig(kv=True)
+    if key == "all":
+        return QuantConfig(weights=True, kv=True)
+    raise ValueError(
+        f"quantize mode must be off|weights|kv|all, got {mode!r}"
+    )
+
+
+def _xp(x):
+    """numpy for numpy inputs, jax.numpy for everything else."""
+    if isinstance(x, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# --------------------------------------------------------------- weights
+
+
+def quantize_channelwise(w, axis: int = -2):
+    """Per-output-channel symmetric int8: reduce |w| over `axis` (the input
+    axis of the [..., in, out] matmul layout). Returns (int8 values with
+    w's shape, f32 scales with `axis` removed)."""
+    xp = _xp(w)
+    wf = w.astype(np.float32)
+    amax = xp.max(xp.abs(wf), axis=axis)
+    scale = xp.maximum(amax, _EPS) / _QMAX
+    q = xp.clip(
+        xp.round(wf / xp.expand_dims(scale, axis)), -_QMAX, _QMAX
+    ).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequantize_channelwise(q, scale, dtype=None, axis: int = -2):
+    """Inverse of quantize_channelwise (tests / reference math — the
+    serving matmuls never materialize this; they scale the output)."""
+    xp = _xp(q)
+    out = q.astype(np.float32) * xp.expand_dims(scale, axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def quantize_params(params: dict, names=WEIGHT_QUANT_NAMES) -> dict:
+    """Quantize the projection weights of a param pytree in place of their
+    bf16 leaves, adding `<name>_scale` companions. Idempotent: leaves that
+    already carry a scale (or are already int8) pass through untouched.
+    Works on numpy and jax pytrees (dict shape preserved)."""
+    out: dict = {}
+    for name, v in params.items():
+        out[name] = v
+    for name in names:
+        v = out.get(name)
+        if v is None or f"{name}{SCALE_SUFFIX}" in out:
+            continue
+        if np.dtype(v.dtype) == np.int8:
+            continue
+        q, scale = quantize_channelwise(v)
+        out[name] = q
+        out[f"{name}{SCALE_SUFFIX}"] = scale
+    return out
+
+
+# -------------------------------------------------------------------- KV
+
+
+def quantize_kv(kv):
+    """Quantize K or V vectors on write: absmax over the trailing head_dim
+    axis. kv [..., D] -> (int8 [..., D], f32 [...])."""
+    xp = _xp(kv)
+    kvf = kv.astype(np.float32)
+    amax = xp.max(xp.abs(kvf), axis=-1)
+    scale = xp.maximum(amax, _EPS) / _QMAX
+    q = xp.clip(
+        xp.round(kvf / scale[..., None]), -_QMAX, _QMAX
+    ).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequantize_kv(q, scale, dtype):
+    """Dequantize gathered KV cells on read: values [..., D] * scales
+    [..., 1] -> `dtype` (the attention op's compute dtype)."""
+    return (q.astype(np.float32) * scale[..., None]).astype(dtype)
+
+
+def kv_cell_bytes(head_dim: int, quantized: bool,
+                  itemsize: int = 2) -> float:
+    """HBM bytes per cached (token, head) cell: D values plus, when
+    quantized, one f32 scale amortized over the vector. The honest figure
+    the bytes-per-token / bytes-per-page accounting uses."""
+    if quantized:
+        return head_dim * 1 + np.dtype(KV_SCALE_DTYPE).itemsize
+    return head_dim * itemsize

@@ -450,6 +450,146 @@ async def run_mixed_length_bench(requests_n: int) -> dict:
     }
 
 
+async def run_quantized_bench(requests_n: int) -> dict:
+    """Int8-KV occupancy and throughput at EQUAL HBM budget
+    (docs/quantization.md). Three engines, identical except the
+    `--quantize` knob: bf16 baseline, int8 KV pages, int8 weights+KV.
+    The quantized pools get as many pages as the bf16 pool's BYTES buy
+    (bytes_per_page is ~(D+4)/2D of bf16, so ~1.9x the pages), and a
+    saturating swarm of identical short chats measures peak concurrent
+    sequences per budget — the paged-attention analogue of the
+    mixed-length dense-vs-paged bench. Also reports decode tok/s and a
+    greedy output-divergence sample (int8 vs bf16 token streams on the
+    same prompts)."""
+    import dataclasses as dc
+    import random
+
+    import jax.numpy as jnp
+
+    from llmlb_tpu.engine.presets import get_preset
+    from llmlb_tpu.engine.scheduler import SamplingParams, kv_page_bytes
+    from llmlb_tpu.engine.service import Engine
+    from llmlb_tpu.engine.tokenizer import ByteTokenizer
+    from llmlb_tpu.engine.scheduler import EngineCore
+
+    # head_dim 64 at bf16 — the serving-shaped cell: int8 page bytes are
+    # (64+4)/(64·2) = 53% of bf16, so one HBM budget holds ~1.88x pages
+    cfg = dc.replace(
+        get_preset("debug-tiny"), hidden_size=256, num_heads=4,
+        num_kv_heads=2, intermediate_size=512, dtype=jnp.bfloat16,
+    )
+    capacity, page = 64, 16
+    bf16_pages = 33  # 32 usable + trash page: the HBM budget
+    budget_bytes = bf16_pages * kv_page_bytes(cfg, page, quantized=False)
+    int8_pages = budget_bytes // kv_page_bytes(cfg, page, quantized=True)
+    # 28-token prompts reserve BOTH of a request's pages at admission
+    # (prompt+gen stays inside 2 pages), so peak concurrency is bounded by
+    # the pool, not by decode-growth cuts — the quantity under test
+    prompt_len, gen = 28, 3
+
+    r = random.Random(0)
+    prompts = [[r.randrange(1, cfg.vocab_size)
+                for _ in range(prompt_len)] for _ in range(requests_n)]
+    divergence_prompts = prompts[:4]
+
+    results: dict = {}
+    baseline_tokens: list[list[int]] | None = None
+    for mode in ("bf16", "int8-kv", "int8-all"):
+        quantize = {"bf16": "off", "int8-kv": "kv", "int8-all": "all"}[mode]
+        pages = bf16_pages if mode == "bf16" else int(int8_pages)
+        core = EngineCore(
+            cfg, num_slots=32, slot_capacity=capacity,
+            prefill_buckets=(16,), seed=0, kv_page_size=page,
+            kv_pages=pages, quantize=quantize, prefix_cache=False,
+        )
+        core.start()
+        engine = Engine("quant-bench", core, ByteTokenizer(cfg.vocab_size))
+        try:
+            peak = 0
+            done = False
+
+            async def sample() -> None:
+                nonlocal peak
+                while not done:
+                    peak = max(peak, core.stats().active_slots)
+                    await asyncio.sleep(0.002)
+
+            sampler = asyncio.create_task(sample())
+            t0 = time.perf_counter()
+            outs = await asyncio.gather(*(
+                engine.complete(p, SamplingParams(temperature=0.0,
+                                                  max_tokens=gen))
+                for p in prompts
+            ))
+            elapsed = time.perf_counter() - t0
+            done = True
+            await sampler
+
+            # greedy divergence sample vs the bf16 streams
+            sample_tokens = []
+            for p in divergence_prompts:
+                req_toks = []
+                async for delta in engine.stream(
+                    p, SamplingParams(temperature=0.0, max_tokens=8)
+                ):
+                    req_toks.append(delta.text)
+                sample_tokens.append("".join(req_toks))
+            if baseline_tokens is None:
+                baseline_tokens = sample_tokens
+                diverged = 0.0
+            else:
+                diverged = sum(
+                    1 for a, b in zip(baseline_tokens, sample_tokens)
+                    if a != b
+                ) / len(sample_tokens)
+
+            completion_tokens = sum(o.completion_tokens for o in outs)
+            info = core.kv_cache_info()
+            results[mode] = {
+                "quantize": quantize,
+                "kv_dtype": info["kv_dtype"],
+                "pages_total": info["pages_total"],
+                "bytes_per_page": info["bytes_per_page"],
+                "kv_hbm_bytes": info["hbm_bytes"],
+                "peak_concurrent_sequences": peak,
+                "decode_tokens_per_sec": round(
+                    completion_tokens / elapsed, 1
+                ),
+                "seconds": round(elapsed, 2),
+                "finished": sum(
+                    1 for o in outs
+                    if o.finish_reason in ("stop", "length")
+                ),
+                "output_divergence_sample": round(diverged, 3),
+                "param_bytes": core.quant_info()["param_bytes"],
+            }
+        finally:
+            engine.shutdown()
+
+    bf16_b = results["bf16"]["kv_hbm_bytes"]
+    kv_b = results["int8-kv"]["kv_hbm_bytes"]
+    return {
+        "metric": "quantized_equal_hbm_budget",
+        "requests": requests_n,
+        "hbm_budget_bytes": budget_bytes,
+        # pools match the budget within one page's rounding
+        "equal_hbm_budget": abs(kv_b - bf16_b) <= results["int8-kv"][
+            "bytes_per_page"
+        ],
+        "peak_concurrency_gain_int8_kv": round(
+            results["int8-kv"]["peak_concurrent_sequences"]
+            / max(1, results["bf16"]["peak_concurrent_sequences"]), 2
+        ),
+        "bytes_per_page_ratio": round(
+            results["int8-kv"]["bytes_per_page"]
+            / results["bf16"]["bytes_per_page"], 3
+        ),
+        "bf16": results["bf16"],
+        "int8_kv": results["int8-kv"],
+        "int8_all": results["int8-all"],
+    }
+
+
 async def run_structured_bench(requests: int) -> dict:
     """Structured-outputs workload: mixed schema-constrained + free-form
     traffic through the full gateway against a real tpu:// engine (CPU
@@ -892,12 +1032,13 @@ def main() -> None:
     parser.add_argument(
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
-                 "structured", "spec-decode"),
+                 "structured", "spec-decode", "quantized"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
                         help="request count for --workload shared-prefix / "
-                             "mixed-length / structured / spec-decode")
+                             "mixed-length / structured / spec-decode / "
+                             "quantized")
     args = parser.parse_args()
     if args.workload not in ("proxy", "chaos"):
         _pin_platform()  # engine workloads touch jax: decide platform first
@@ -909,6 +1050,14 @@ def main() -> None:
         result = asyncio.run(run_spec_bench(args.requests))
     elif args.workload == "mixed-length":
         result = asyncio.run(run_mixed_length_bench(args.requests))
+    elif args.workload == "quantized":
+        if args.requests < 40:
+            # the peak-concurrency measurement needs enough requests to
+            # saturate the int8 pool (~30 concurrent at the bench sizing)
+            print(f"[bench] --requests {args.requests} raised to 40: the "
+                  "quantized workload must saturate the page pool",
+                  file=sys.stderr)
+        result = asyncio.run(run_quantized_bench(max(args.requests, 40)))
     elif args.workload == "chaos":
         result = asyncio.run(
             run_chaos_bench(args.seconds, min(args.concurrency, 16))

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Cross-check `LLMLB_*` environment knobs against the docs.
+
+Every `LLMLB_[A-Z0-9_]+` name referenced anywhere in `llmlb_tpu/` source
+must be named VERBATIM somewhere under `docs/` (docs/configuration.md is
+the canonical table) — a new knob, like `LLMLB_QUANTIZE`, cannot ship
+undocumented. Wired as a tier-1 test (tests/test_env_docs.py), same
+pattern as scripts/check_metrics_docs.py; also runnable standalone:
+
+    python scripts/check_env_docs.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SRC = REPO / "llmlb_tpu"
+DOCS = REPO / "docs"
+
+_KNOB_RE = re.compile(r"LLMLB_[A-Z0-9_]+")
+
+
+def source_knobs() -> set[str]:
+    """Every LLMLB_* name in llmlb_tpu/ .py sources. Matches that end with
+    an underscore are glob-style prose ("LLMLB_SPEC_{DECODE,...}",
+    "LLMLB_RETRY_*") — skipped, their expansions are matched directly."""
+    names: set[str] = set()
+    for path in sorted(SRC.rglob("*.py")):
+        for m in _KNOB_RE.findall(path.read_text()):
+            if not m.endswith("_"):
+                names.add(m)
+    return names
+
+
+def documented_knobs() -> set[str]:
+    names: set[str] = set()
+    for path in sorted(DOCS.rglob("*.md")):
+        for m in _KNOB_RE.findall(path.read_text()):
+            if not m.endswith("_"):
+                names.add(m)
+    return names
+
+
+def undocumented() -> list[str]:
+    return sorted(source_knobs() - documented_knobs())
+
+
+def main() -> int:
+    knobs = source_knobs()
+    missing = sorted(knobs - documented_knobs())
+    if missing:
+        print("env knobs referenced in llmlb_tpu/ but undocumented in "
+              "docs/ (add them to docs/configuration.md):", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+        return 1
+    print(f"all {len(knobs)} LLMLB_* knobs are documented")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -60,12 +60,14 @@ def engine_metric_names() -> set[str]:
             "layout": "paged", "page_size": 128, "pages_total": 0,
             "pages_free": 0, "pages_active": 0, "pages_pinned": 0,
             "utilization": 0.0, "fragmentation": 0.0,
-            "waste_tokens_mean": 0.0,
+            "waste_tokens_mean": 0.0, "bytes_per_page": 0, "hbm_bytes": 0,
+            "kv_dtype": "int8",
         },
         perf={
             "available": True, "mfu": 0.0, "hbm_bw_utilization": 0.0,
             "flops_per_token": 0.0, "bytes_per_token": 0.0,
         },
+        quant={"mode": "all", "param_bytes": 0},
     )
     return set(_TYPE_RE.findall(text))
 

@@ -12,13 +12,21 @@ from llmlb_tpu.engine.service import Engine
 
 
 # The whole serving contract runs over BOTH KV layouts: paged (default —
-# shared page pool + block tables) and dense (the original slot cache).
-@pytest.fixture(scope="module", params=["paged", "dense"])
+# shared page pool + block tables) and dense (the original slot cache) —
+# plus the paged layout with the int8 quantization knob EXPLICITLY off,
+# proving the quantization plumbing is zero-cost when disabled
+# (docs/quantization.md; bit-identity itself is pinned by
+# test_quantized_serving.test_quantize_off_bit_identical).
+@pytest.fixture(scope="module",
+                params=["paged", "dense", "paged-quantize-off"])
 def engine(request):
+    layout = "dense" if request.param == "dense" else "paged"
+    extra = ({"quantize": "off"} if request.param == "paged-quantize-off"
+             else {})
     eng = Engine.from_preset(
         "debug-tiny", num_slots=4, slot_capacity=64,
         prefill_buckets=(16, 32), seed=0,
-        kv_layout=request.param, kv_page_size=16,
+        kv_layout=layout, kv_page_size=16, **extra,
     )
     yield eng
     eng.shutdown()

@@ -334,6 +334,30 @@ def test_paged_gauges_in_metrics_and_system(prompt):
         core.stop()
 
 
+def test_paged_goldens_identical_with_quantize_off(prompt):
+    """Golden run over the quantization knob: an explicit quantize="off"
+    engine produces the exact token streams (greedy AND seeded stochastic)
+    and the exact kv gauges the default engine does — the int8 plumbing is
+    provably zero-cost when disabled (docs/quantization.md)."""
+    results = {}
+    for quantize in (None, "off"):
+        core = _core(quantize=quantize)
+        core.start()
+        try:
+            greedy = _req(prompt, max_tokens=8)
+            seeded = Request(prompt_ids=list(prompt),
+                            sampling=SamplingParams(temperature=0.9,
+                                                    max_tokens=8, seed=5))
+            core.submit(greedy)
+            core.submit(seeded)
+            toks_g, _ = _collect(greedy)
+            toks_s, _ = _collect(seeded)
+            results[quantize] = (toks_g, toks_s, core.kv_cache_info())
+        finally:
+            core.stop()
+    assert results[None] == results["off"]
+
+
 def test_dense_layout_reports_dense_info():
     core = _core(kv_layout="dense")
     try:

@@ -1,0 +1,153 @@
+"""Int8-paged attention parity: the quantized pool read paths (XLA gather
+fallback AND Pallas interpret-mode kernels) must track the bf16 baseline
+within the docs/quantization.md tolerance on unit-variance inputs."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from llmlb_tpu.ops.attention import (
+    gather_kv_pages,
+    paged_attention_decode,
+    paged_attention_extend,
+)
+from llmlb_tpu.ops.pallas_attention import (
+    paged_flash_decode,
+    paged_flash_decode_quant,
+    paged_flash_extend,
+    paged_flash_extend_quant,
+)
+from llmlb_tpu.quant import quantize_kv
+
+B, H, K, D, P, PS, PPN = 2, 8, 4, 16, 9, 8, 4
+TOL = 0.05
+
+
+def _pools(seed=0):
+    rng = np.random.default_rng(seed)
+    k_pages = rng.normal(size=(P, PS, K, D)).astype(np.float32)
+    v_pages = rng.normal(size=(P, PS, K, D)).astype(np.float32)
+    kq, ks = quantize_kv(k_pages)
+    vq, vs = quantize_kv(v_pages)
+    tables = np.array([[1, 2, 3, 0], [4, 5, 6, 0]], np.int32)
+    return (jnp.asarray(k_pages), jnp.asarray(v_pages),
+            {"q": jnp.asarray(kq), "s": jnp.asarray(ks)},
+            {"q": jnp.asarray(vq), "s": jnp.asarray(vs)},
+            jnp.asarray(tables), rng)
+
+
+def test_gather_kv_pages_dequantizes():
+    k_pages, _, qk, _, tables, _ = _pools()
+    dense = gather_kv_pages(k_pages, tables)
+    deq = gather_kv_pages(qk, tables)
+    assert deq.dtype == jnp.bfloat16
+    assert np.abs(np.asarray(deq, np.float32)
+                  - np.asarray(dense)).max() < TOL
+
+
+def test_paged_decode_xla_parity():
+    k_pages, v_pages, qk, qv, tables, rng = _pools(1)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    kv_lens = jnp.asarray([PS * 3, PS * 2], jnp.int32)
+    base = paged_attention_decode(q, k_pages, v_pages, tables, kv_lens)
+    quant = paged_attention_decode(q, qk, qv, tables, kv_lens)
+    assert np.abs(np.asarray(base) - np.asarray(quant,
+                                                np.float32)).max() < TOL
+
+
+def test_paged_extend_xla_parity():
+    k_pages, v_pages, qk, qv, tables, rng = _pools(2)
+    t = 4
+    q = jnp.asarray(rng.normal(size=(B, t, H, D)), jnp.float32)
+    start = jnp.asarray([8, 4], jnp.int32)
+    positions = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    lens = jnp.asarray([t, t - 1], jnp.int32)
+    base = paged_attention_extend(q, k_pages, v_pages, tables, positions,
+                                  lens)
+    quant = paged_attention_extend(q, qk, qv, tables, positions, lens)
+    assert np.abs(np.asarray(base) - np.asarray(quant,
+                                                np.float32)).max() < TOL
+
+
+def test_paged_flash_decode_quant_interpret_parity():
+    """Interpret-mode kernel vs both the bf16 kernel (tolerance) and the
+    XLA dequant route (the two quantized paths read identical cells)."""
+    k_pages, v_pages, qk, qv, tables, rng = _pools(3)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kv_lens = jnp.asarray([PS * 3 - 2, PS + 3], jnp.int32)
+    base = paged_flash_decode(q, k_pages, v_pages, tables, kv_lens,
+                              interpret=True)
+    quant = paged_flash_decode_quant(
+        q, qk["q"], qk["s"], qv["q"], qv["s"], tables, kv_lens,
+        interpret=True,
+    )
+    assert np.abs(np.asarray(base) - np.asarray(quant)).max() < TOL
+
+    # both quantized routes dequant to q.dtype before the dots, so they
+    # differ only by online- vs plain-softmax accumulation order
+    xla = paged_attention_decode(q[:, None], qk, qv, tables, kv_lens)[:, 0]
+    assert np.abs(np.asarray(quant)
+                  - np.asarray(xla, np.float32)).max() < 2e-3
+
+
+def test_paged_flash_decode_quant_respects_pages_window():
+    """Rows within the swept pages stay exact when the sweep is bounded —
+    the dequant variant must keep flash_decode's window contract."""
+    k_pages, v_pages, qk, qv, tables, rng = _pools(4)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    kv_lens = jnp.asarray([PS * 2, PS], jnp.int32)  # within 2 pages
+    full = paged_flash_decode_quant(
+        q, qk["q"], qk["s"], qv["q"], qv["s"], tables, kv_lens,
+        interpret=True,
+    )
+    windowed = paged_flash_decode_quant(
+        q, qk["q"], qk["s"], qv["q"], qv["s"], tables, kv_lens, pages=2,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(full), np.asarray(windowed),
+                               atol=1e-6)
+
+
+def test_paged_flash_extend_quant_interpret_parity():
+    k_pages, v_pages, qk, qv, tables, rng = _pools(5)
+    t = 6
+    q = jnp.asarray(rng.normal(size=(B, t, H, D)), jnp.float32)
+    start = jnp.asarray([10, 2], jnp.int32)
+    lens = jnp.asarray([t, t - 2], jnp.int32)
+    base = paged_flash_extend(q, k_pages, v_pages, tables, start, lens,
+                              interpret=True)
+    quant = paged_flash_extend_quant(
+        q, qk["q"], qk["s"], qv["q"], qv["s"], tables, start, lens,
+        interpret=True,
+    )
+    # padding rows past chunk_lens are garbage in both — compare valid rows
+    for b, n in enumerate([t, t - 2]):
+        assert np.abs(np.asarray(base)[b, :n]
+                      - np.asarray(quant)[b, :n]).max() < TOL
+
+
+@pytest.mark.parametrize("route", ["decode", "extend"])
+def test_quantized_pool_means_quantized_kernel(route, monkeypatch):
+    """The dispatcher must route {"q","s"} pools to the quant kernels when
+    Pallas is enabled — mixing an int8 pool into the bf16 kernel would be
+    garbage, not an error."""
+    import llmlb_tpu.ops.attention as attn
+
+    monkeypatch.setenv("LLMLB_TPU_ATTENTION", "pallas")
+    k_pages, v_pages, qk, qv, tables, rng = _pools(6)
+    if route == "decode":
+        q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+        kv_lens = jnp.asarray([PS, PS], jnp.int32)
+        out = attn.paged_attention_decode(q, qk, qv, tables, kv_lens)
+        ref = attn.paged_attention_decode(q, k_pages, v_pages, tables,
+                                          kv_lens)
+    else:
+        q = jnp.asarray(rng.normal(size=(B, 3, H, D)), jnp.float32)
+        positions = jnp.asarray([[8, 9, 10], [4, 5, 6]], jnp.int32)
+        lens = jnp.asarray([3, 3], jnp.int32)
+        out = attn.paged_attention_extend(q, qk, qv, tables, positions,
+                                          lens)
+        ref = attn.paged_attention_extend(q, k_pages, v_pages, tables,
+                                          positions, lens)
+    assert np.abs(np.asarray(out, np.float32)
+                  - np.asarray(ref, np.float32)).max() < TOL

@@ -1,0 +1,157 @@
+"""Quantization primitives: scale correctness, round-trip error bounds,
+pytree quantization, KV vector round trips, knob parsing, byte math.
+Tolerances follow docs/quantization.md's error model (per-element error
+<= scale/2 = group absmax / 254)."""
+
+import numpy as np
+import pytest
+
+from llmlb_tpu.quant import (
+    WEIGHT_QUANT_NAMES,
+    dequantize_channelwise,
+    dequantize_kv,
+    kv_cell_bytes,
+    parse_quant_mode,
+    quantize_channelwise,
+    quantize_kv,
+    quantize_params,
+)
+
+# ------------------------------------------------------------------ weights
+
+
+def test_channelwise_scale_is_per_output_channel():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(8, 16)).astype(np.float32)  # [in, out]
+    q, scale = quantize_channelwise(w)
+    assert q.shape == w.shape and q.dtype == np.int8
+    assert scale.shape == (16,) and scale.dtype == np.float32
+    # scale is the column absmax / 127 — per OUTPUT channel
+    np.testing.assert_allclose(scale, np.abs(w).max(axis=0) / 127.0,
+                               rtol=1e-6)
+    # the absmax element of every column quantizes to ±127 exactly
+    assert (np.abs(q).max(axis=0) == 127).all()
+
+
+def test_channelwise_round_trip_error_bound():
+    rng = np.random.default_rng(1)
+    w = (rng.normal(size=(4, 32, 64)) * rng.uniform(0.1, 10)).astype(
+        np.float32
+    )  # stacked [L, in, out]
+    q, scale = quantize_channelwise(w)
+    back = dequantize_channelwise(q, scale)
+    # per-element error <= scale/2 (round-to-nearest), i.e. absmax/254
+    bound = np.abs(w).max(axis=1, keepdims=True) / 253.0
+    assert (np.abs(back - w) <= bound + 1e-7).all()
+
+
+def test_channelwise_matmul_scale_commutes():
+    """The serving matmul applies the scale to the OUTPUT; that must equal
+    dequantizing the weight first (the scale is constant along the
+    contraction)."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 12)).astype(np.float32)
+    q, scale = quantize_channelwise(w)
+    via_output = (x @ q.astype(np.float32)) * scale
+    via_weight = x @ dequantize_channelwise(q, scale)
+    np.testing.assert_allclose(via_output, via_weight, rtol=1e-6)
+
+
+def test_all_zero_channel_quantizes_to_zero():
+    w = np.zeros((4, 4), np.float32)
+    q, scale = quantize_channelwise(w)
+    assert (q == 0).all() and (scale > 0).all()
+    assert (dequantize_channelwise(q, scale) == 0).all()
+
+
+def test_quantize_params_is_idempotent_and_selective():
+    rng = np.random.default_rng(3)
+    params = {
+        "wq": rng.normal(size=(2, 8, 8)).astype(np.float32),
+        "embed": rng.normal(size=(16, 8)).astype(np.float32),
+        "ln_attn": np.ones((2, 8), np.float32),
+    }
+    out = quantize_params(params)
+    assert out["wq"].dtype == np.int8 and "wq_scale" in out
+    # embeddings/norms stay untouched
+    assert out["embed"] is params["embed"]
+    assert out["ln_attn"] is params["ln_attn"]
+    assert "embed_scale" not in out and "ln_attn_scale" not in out
+    # second pass is a no-op (same arrays, no double quantization)
+    again = quantize_params(out)
+    assert again["wq"] is out["wq"]
+    assert again["wq_scale"] is out["wq_scale"]
+
+
+def test_quantize_params_covers_both_families():
+    assert {"wq", "wk", "wv", "wo", "wg", "wu", "wd"} <= set(
+        WEIGHT_QUANT_NAMES
+    )
+    assert {"we_gate", "we_up", "we_down"} <= set(WEIGHT_QUANT_NAMES)
+
+
+# ----------------------------------------------------------------------- KV
+
+
+def test_kv_round_trip_error_bound():
+    rng = np.random.default_rng(4)
+    kv = (rng.normal(size=(3, 5, 4, 16)) * 3).astype(np.float32)
+    q, scale = quantize_kv(kv)
+    assert q.shape == kv.shape and q.dtype == np.int8
+    assert scale.shape == kv.shape[:-1] and scale.dtype == np.float32
+    back = dequantize_kv(q, scale, np.float32)
+    bound = np.abs(kv).max(axis=-1, keepdims=True) / 253.0
+    assert (np.abs(back - kv) <= bound + 1e-7).all()
+
+
+def test_kv_scale_is_per_vector():
+    kv = np.stack([np.full((8,), 2.0), np.full((8,), 0.5)]).astype(
+        np.float32
+    )
+    _, scale = quantize_kv(kv)
+    np.testing.assert_allclose(scale, [2.0 / 127, 0.5 / 127], rtol=1e-6)
+
+
+def test_kv_quantize_works_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    kv = jnp.asarray(np.random.default_rng(5).normal(size=(2, 4, 8)),
+                     jnp.float32)
+    q, scale = jax.jit(quantize_kv)(kv)
+    back = dequantize_kv(np.asarray(q), np.asarray(scale), np.float32)
+    assert np.abs(back - np.asarray(kv)).max() < 0.05
+
+
+# -------------------------------------------------------------------- knobs
+
+
+@pytest.mark.parametrize("mode,weights,kv", [
+    (None, False, False), ("off", False, False), ("0", False, False),
+    ("weights", True, False), ("kv", False, True), ("all", True, True),
+    ("ALL", True, True),
+])
+def test_parse_quant_mode(mode, weights, kv, monkeypatch):
+    monkeypatch.delenv("LLMLB_QUANTIZE", raising=False)
+    qc = parse_quant_mode(mode)
+    assert (qc.weights, qc.kv) == (weights, kv)
+
+
+def test_parse_quant_mode_env_fallback(monkeypatch):
+    monkeypatch.setenv("LLMLB_QUANTIZE", "kv")
+    assert parse_quant_mode(None).mode == "kv"
+    monkeypatch.delenv("LLMLB_QUANTIZE")
+    assert parse_quant_mode(None).mode == "off"
+
+
+def test_parse_quant_mode_rejects_typos():
+    with pytest.raises(ValueError):
+        parse_quant_mode("int8")  # must not silently serve bf16
+
+
+def test_kv_cell_bytes():
+    # bf16: D*2; int8: D*1 + one f32 scale
+    assert kv_cell_bytes(64, False, 2) == 128
+    assert kv_cell_bytes(64, True, 2) == 68
+    assert kv_cell_bytes(128, True, 2) == 132
