@@ -1024,7 +1024,13 @@ def main(argv: list[str] | None = None) -> None:
     if args.min_prefix_len is not None:
         extra["min_prefix_len"] = max(1, args.min_prefix_len)
 
-    logging.basicConfig(level=logging.INFO)
+    # Shared logging subsystem (VERDICT L1 gap closed gateway-side in
+    # logging_setup.py): level/format knobs + the worker-id field apply to
+    # engine processes too. No file sink here — engines run under their own
+    # supervisors that capture stderr.
+    from llmlb_tpu.gateway.logging_setup import init_logging
+
+    init_logging(file_sink=False)
     # TPU backend-init hang guard: BEFORE the first in-process jax backend
     # touch (which construction below triggers), prove the backend comes up
     # in a probe child or fail fast with the captured init-log evidence.

@@ -613,6 +613,14 @@ async def _stream_transform(
                 else None)
     ttft_s: float | None = None
 
+    # Hot loop locals: the dialect transform must JSON-parse each frame (it
+    # rewrites OpenAI chunks into Anthropic events, unlike the byte-for-byte
+    # OpenAI passthrough), but the line splitter and writer should not pay
+    # attribute walks per line on top of that.
+    loads = json.loads
+    encoder_feed = encoder.feed
+    resp_write = resp.write
+
     async def pump(raw_chunk: bytes) -> None:
         nonlocal buffer
         buffer += raw_chunk
@@ -626,11 +634,11 @@ async def _stream_transform(
             if not data or data == b"[DONE]":
                 continue
             try:
-                chunk = json.loads(data)
+                chunk = loads(data)
             except ValueError:
                 continue
-            for event in encoder.feed(chunk):
-                await resp.write(event)
+            for event in encoder_feed(chunk):
+                await resp_write(event)
                 wrote = True
         if wrote and timeline is not None:
             timeline.mark()
@@ -641,9 +649,10 @@ async def _stream_transform(
                                 started, streaming=True)
             ttft_s = time.monotonic() - started
             await pump(first_chunk)
+            next_chunk = iterator.__anext__
             while True:
                 try:
-                    raw_chunk = await iterator.__anext__()
+                    raw_chunk = await next_chunk()
                 except StopAsyncIteration:
                     break
                 except (aiohttp.ClientError, asyncio.TimeoutError,

@@ -196,12 +196,7 @@ def _record(
         prompt_tokens=prompt_tokens, completion_tokens=completion_tokens,
     ))
     auth = auth or {}
-    state.db.execute(
-        """INSERT INTO request_history
-           (id, ts, endpoint_id, endpoint_name, model, api_kind, path,
-            status_code, duration_ms, prompt_tokens, completion_tokens,
-            client_ip, api_key_id, user_id, stream, error, request_body)
-           VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)""",
+    state.history.add_history(
         (uuid.uuid4().hex, time.time(), eid,
          endpoint.name if endpoint else None, model, api_kind.value, path,
          status, duration_ms, prompt_tokens, completion_tokens, client_ip,
@@ -557,9 +552,17 @@ async def _forward_stream(
             await resp.write(first_chunk)
             if timeline is not None and b"data:" in first_chunk:
                 timeline.mark()
+            # Per-chunk hot loop: with the native scanner built, each chunk
+            # costs one C scan (frame split + usage extract) and one socket
+            # write — bound methods hoisted so the loop does no attribute
+            # walks, and the timeline branch is a single identity test
+            # unless this request was sampled for a token timeline.
+            feed = acc.feed
+            write = resp.write
+            next_chunk = iterator.__anext__
             while True:
                 try:
-                    chunk = await iterator.__anext__()
+                    chunk = await next_chunk()
                 except StopAsyncIteration:
                     break
                 except (aiohttp.ClientError, asyncio.TimeoutError,
@@ -571,8 +574,8 @@ async def _forward_stream(
                     upstream_failed = True
                     await resp.write(sse_error_frame(error))
                     break
-                acc.feed(chunk)
-                await resp.write(chunk)
+                feed(chunk)
+                await write(chunk)
                 if timeline is not None and b"data:" in chunk:
                     timeline.mark()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,

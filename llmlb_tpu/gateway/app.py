@@ -556,6 +556,11 @@ async def _api_health(request: web.Request) -> web.Response:
             "active_requests": state.load_manager.total_active(),
         },
     }
+    if state.worker.multi:
+        body["worker"] = {"index": state.worker.index,
+                          "count": state.worker.count}
+        if state.gossip is not None:
+            body["gossip"] = state.gossip.stats()
     if state.resilience is not None:
         cfg = state.resilience.config
         body["resilience"] = {
@@ -573,29 +578,36 @@ async def _api_health(request: web.Request) -> web.Response:
 async def _gateway_metrics(request: web.Request) -> web.Response:
     """GET /metrics — gateway-wide Prometheus exposition: per-model/endpoint
     TTFT, e2e, and queue-wait histograms, per-route counters, plus
-    scrape-time gauges owned by the balancer and event bus."""
-    state: AppState = request.app["state"]
-    affinity = state.load_manager.affinity_stats()
-    text = state.metrics.render(
-        counters={
-            "llmlb_gateway_dropped_events_total":
-                state.events.dropped_events_total(),
-            "llmlb_gateway_prefix_affinity_hits_total":
-                affinity["hits_total"],
-            "llmlb_gateway_prefix_affinity_misses_total":
-                affinity["misses_total"],
-            "llmlb_gateway_prefix_affinity_evictions_total":
-                affinity["evictions_total"],
-        },
-        gauges={
-            "llmlb_gateway_active_requests":
-                state.load_manager.total_active(),
-            "llmlb_gateway_admission_queue_depth":
-                state.admission.queue_depth(),
-            "llmlb_gateway_traces_buffered": len(state.traces),
-            "llmlb_gateway_prefix_affinity_entries": affinity["entries"],
-        },
+    scrape-time gauges owned by the balancer and event bus.
+
+    Multi-worker: SO_REUSEPORT hands the scrape to ONE arbitrary worker, so
+    the serving worker labels its own series worker="i", refreshes its
+    spool, and appends its siblings' spooled (already-labeled) series —
+    Prometheus sees the whole group on every scrape, attributable per
+    worker (docs/deployment.md)."""
+    from llmlb_tpu.gateway.app_state import (
+        gateway_exposition,
+        read_peer_metrics,
+        write_metrics_spool,
     )
+    from llmlb_tpu.gateway.config import env_float
+    from llmlb_tpu.gateway.metrics import label_exposition
+
+    state: AppState = request.app["state"]
+    text = gateway_exposition(state)
+    if state.worker.multi:
+        from llmlb_tpu.gateway.app_state import METRICS_SPOOL_DEFAULT_S
+
+        text = label_exposition(text, "worker", state.worker.label)
+        try:
+            # scrape-fresh spool for whoever serves the next scrape, from
+            # the text already rendered above (no second exposition build)
+            write_metrics_spool(state, labeled_text=text)
+        except OSError:
+            pass
+        interval = env_float("LLMLB_METRICS_SPOOL_SECS",
+                             METRICS_SPOOL_DEFAULT_S)
+        text += read_peer_metrics(state, max_age_s=3 * interval + 2.0)
     return web.Response(text=text, content_type="text/plain", charset="utf-8")
 
 

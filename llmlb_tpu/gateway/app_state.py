@@ -12,7 +12,9 @@ import asyncio
 import dataclasses
 import datetime
 import logging
+import os
 import secrets
+import threading
 import time
 
 import aiohttp
@@ -24,26 +26,149 @@ from llmlb_tpu.gateway.auth import (
     UserStore,
     ensure_admin_exists,
 )
-from llmlb_tpu.gateway.balancer import AdmissionQueue, LoadManager
+from llmlb_tpu.gateway.balancer import (
+    AdmissionQueue,
+    LoadManager,
+    default_affinity_mode,
+)
 from llmlb_tpu.gateway.config import (
     QueueConfig,
     ResilienceConfig,
     ServerConfig,
     SloConfig,
+    env_bool,
+    env_float,
     env_int,
 )
 from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
 from llmlb_tpu.gateway.faults import FaultInjector
 from llmlb_tpu.gateway.gate import InferenceGate
+from llmlb_tpu.gateway.gossip import GossipBus, default_gossip_dir
 from llmlb_tpu.gateway.health import EndpointHealthChecker
 from llmlb_tpu.gateway.metrics import GatewayMetrics
 from llmlb_tpu.gateway.registry import EndpointRegistry
 from llmlb_tpu.gateway.resilience import ResilienceManager
 from llmlb_tpu.gateway.tracing import TraceStore
 from llmlb_tpu.gateway.types import TpsApiKind
+from llmlb_tpu.gateway.worker import WorkerInfo, current_worker
 
 log = logging.getLogger("llmlb_tpu.gateway")
+
+
+class HistoryWriter:
+    """Request-history + daily-stat DB writes.
+
+    Synchronous by default (bit-identical to the historical per-request
+    execute). In multi-worker mode (or with LLMLB_HISTORY_FLUSH_SECS set)
+    rows buffer in memory and a periodic task flushes them in one
+    transaction each — N workers' hot paths then take the WAL writer lock a
+    couple of times per second instead of three times per request, which is
+    the difference between near-linear scaling and serializing on SQLite.
+    """
+
+    _HISTORY_SQL = (
+        "INSERT INTO request_history "
+        "(id, ts, endpoint_id, endpoint_name, model, api_kind, path, "
+        " status_code, duration_ms, prompt_tokens, completion_tokens, "
+        " client_ip, api_key_id, user_id, stream, error, request_body) "
+        "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+    _DAILY_SQL = (
+        "INSERT INTO endpoint_daily_stats "
+        "(endpoint_id, date, model, api_kind, request_count, error_count, "
+        " prompt_tokens, completion_tokens, total_duration_ms) "
+        "VALUES (?,?,?,?,1,?,?,?,?) "
+        "ON CONFLICT(endpoint_id, date, model, api_kind) DO UPDATE SET "
+        "request_count = request_count + 1, "
+        "error_count = error_count + excluded.error_count, "
+        "prompt_tokens = prompt_tokens + excluded.prompt_tokens, "
+        "completion_tokens = completion_tokens + excluded.completion_tokens, "
+        "total_duration_ms = total_duration_ms + excluded.total_duration_ms"
+    )
+
+    def __init__(self, db: Database, batched: bool = False,
+                 flush_interval_s: float = 0.5):
+        self.db = db
+        self.batched = batched
+        self.flush_interval_s = flush_interval_s
+        self._lock = threading.Lock()
+        self._history_rows: list[tuple] = []
+        self._daily_rows: list[tuple] = []
+        self._task: asyncio.Task | None = None
+
+    # Backstop for batched writers whose flush task is not running (an
+    # embedder building a multi-worker state with start_background=False):
+    # past this many buffered rows, add_* flushes inline instead of
+    # growing without bound.
+    MAX_BUFFERED_ROWS = 10_000
+
+    def add_history(self, params: tuple) -> None:
+        if not self.batched:
+            self.db.execute(self._HISTORY_SQL, params)
+            return
+        with self._lock:
+            self._history_rows.append(params)
+            overflow = len(self._history_rows) >= self.MAX_BUFFERED_ROWS
+        if overflow:
+            self.flush()
+
+    def add_daily(self, params: tuple) -> None:
+        if not self.batched:
+            self.db.execute(self._DAILY_SQL, params)
+            return
+        with self._lock:
+            self._daily_rows.append(params)
+            overflow = len(self._daily_rows) >= self.MAX_BUFFERED_ROWS
+        if overflow:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            history, self._history_rows = self._history_rows, []
+            daily, self._daily_rows = self._daily_rows, []
+        if not history and not daily:
+            return
+        try:
+            with self.db.transaction():
+                if history:
+                    self.db.executemany(self._HISTORY_SQL, history)
+                for row in daily:  # UPSERT rows may collide per key
+                    self.db.execute(self._DAILY_SQL, row)
+        except Exception:
+            # transient WAL contention must not silently lose a flush
+            # window of history: put the rows back for the next attempt
+            with self._lock:
+                self._history_rows[:0] = history
+                self._daily_rows[:0] = daily
+            raise
+
+    def start(self) -> None:
+        if self.batched and self._task is None:
+            self._task = asyncio.create_task(
+                self._flush_loop(), name="history-writer"
+            )
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval_s)
+            try:
+                self.flush()
+            except Exception:
+                log.exception("request-history flush failed")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        try:
+            self.flush()
+        except Exception:
+            log.exception("final request-history flush failed")
 
 
 @dataclasses.dataclass
@@ -68,6 +193,9 @@ class AppState:
     health_checker: EndpointHealthChecker | None = None
     update_manager: object | None = None  # set by gateway.update
     tray: object | None = None  # TrayController when LLMLB_TRAY=1
+    worker: WorkerInfo = dataclasses.field(default_factory=WorkerInfo)
+    gossip: GossipBus | None = None  # multi-worker state replication
+    history: "HistoryWriter | None" = None
     started_at: float = dataclasses.field(default_factory=time.time)
     _tasks: list[asyncio.Task] = dataclasses.field(default_factory=list)
 
@@ -81,6 +209,10 @@ class AppState:
                 pass
         if self.health_checker:
             await self.health_checker.stop()
+        if self.history is not None:
+            await self.history.stop()
+        if self.gossip is not None:
+            self.gossip.close()
         await self.audit.stop()
         await self.http.close()
         self.db.close()
@@ -91,13 +223,19 @@ async def build_app_state(
     *,
     db: Database | None = None,
     start_background: bool = True,
+    worker: WorkerInfo | None = None,
 ) -> AppState:
     config = config or ServerConfig.from_env()
     if db is None:
         db = Database(config.database_url or ":memory:")
+    if worker is None:
+        worker = current_worker()
 
     registry = EndpointRegistry(db)
-    load_manager = LoadManager(QueueConfig.from_env())
+    load_manager = LoadManager(
+        QueueConfig.from_env(),
+        affinity_mode=default_affinity_mode(worker.count),
+    )
     admission = AdmissionQueue(load_manager)
     events = DashboardEventBus()
     gate = InferenceGate()
@@ -110,7 +248,10 @@ async def build_app_state(
                         events=events)
 
     users = UserStore(db)
-    api_keys = ApiKeyStore(db)
+    api_keys = ApiKeyStore(db, cache_ttl_s=env_float(
+        "LLMLB_AUTH_CACHE_TTL",
+        ApiKeyStore.MULTI_WORKER_DEFAULT_TTL_S if worker.multi else 0.0,
+    ))
     invitations = InvitationStore(db)
 
     # admin bootstrap (reference auth/bootstrap.rs)
@@ -124,11 +265,19 @@ async def build_app_state(
             admin.username, generated,
         )
 
-    # JWT secret: env > persisted setting > fresh random (persisted)
+    # JWT secret: env > persisted setting > fresh random (persisted).
+    # Insert-if-absent then re-read: N workers booting concurrently must all
+    # adopt ONE secret, or a session minted by worker A would 401 on
+    # worker B behind the shared SO_REUSEPORT port.
     jwt_secret = config.jwt_secret or db.get_setting("auth.jwt_secret")
     if not jwt_secret:
-        jwt_secret = secrets.token_urlsafe(32)
-        db.set_setting("auth.jwt_secret", jwt_secret)
+        db.execute(
+            """INSERT INTO settings (key, value, updated_at)
+               VALUES ('auth.jwt_secret', ?, ?)
+               ON CONFLICT(key) DO NOTHING""",
+            (secrets.token_urlsafe(32), time.time()),
+        )
+        jwt_secret = db.get_setting("auth.jwt_secret")
 
     # startup audit chain verification (bootstrap.rs:211-265)
     ok, err = audit.verify()
@@ -150,29 +299,102 @@ async def build_app_state(
     load_manager.resilience = resilience
     faults = FaultInjector.from_env()
 
+    # Per-request history/daily-stat writes: synchronous single-worker (the
+    # historical behavior), batched when N workers share the WAL file or
+    # when LLMLB_HISTORY_FLUSH_SECS opts in explicitly.
+    flush_s = env_float("LLMLB_HISTORY_FLUSH_SECS", 0.0)
+    history = HistoryWriter(
+        db, batched=worker.multi or flush_s > 0,
+        flush_interval_s=flush_s if flush_s > 0 else 0.5,
+    )
+
     state = AppState(
         config=config, db=db, registry=registry, load_manager=load_manager,
         admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
         metrics=metrics, traces=traces, resilience=resilience, faults=faults,
+        worker=worker, history=history,
     )
 
     _seed_tps_from_daily_stats(state)
 
+    # Gossip replication between sibling workers (LLMLB_GOSSIP=0 disables;
+    # single-worker gateways have no siblings and skip it entirely). All
+    # replicated state is advisory: breakers, TPS, retry budget, affinity
+    # pins, registry cache coherence — each converges locally without it.
+    if worker.multi and env_bool("LLMLB_GOSSIP", True):
+        state.gossip = await _start_gossip(state)
+
     if start_background:
         audit.start()
-        checker = EndpointHealthChecker(
-            registry, load_manager, db, http, events,
-            interval_s=config.health_check_interval_s,
-            timeout_s=config.health_check_timeout_s,
-            resilience=resilience,
-        )
-        checker.start()
-        state.health_checker = checker
-        state._tasks.append(
-            asyncio.create_task(_maintenance_loop(state), name="gw-maintenance")
-        )
+        history.start()
+        if worker.multi:
+            interval = env_float(
+                "LLMLB_METRICS_SPOOL_SECS", METRICS_SPOOL_DEFAULT_S
+            )
+            state._tasks.append(asyncio.create_task(
+                _metrics_spool_loop(state, max(0.2, interval)),
+                name="metrics-spool",
+            ))
+        # Single-writer discipline (docs/deployment.md): the pull health
+        # checker, the hourly maintenance loop, and (in server.py) the
+        # update manager's background work run in the elected primary
+        # worker only — N workers probing every engine would multiply
+        # fleet-wide probe load by N for zero information.
+        if worker.is_primary:
+            checker = EndpointHealthChecker(
+                registry, load_manager, db, http, events,
+                interval_s=config.health_check_interval_s,
+                timeout_s=config.health_check_timeout_s,
+                resilience=resilience,
+            )
+            checker.start()
+            state.health_checker = checker
+            state._tasks.append(
+                asyncio.create_task(_maintenance_loop(state),
+                                    name="gw-maintenance")
+            )
     return state
+
+
+async def _start_gossip(state: AppState) -> GossipBus:
+    """Bind this worker's bus socket and wire every replicated-state hook.
+    Receivers apply via ``apply_remote_*`` entry points that never
+    re-publish, so a two-worker group cannot ping-pong a message forever."""
+    bus = GossipBus(
+        default_gossip_dir(state.config.port), state.worker.index,
+        expected_peers=state.worker.count - 1,
+    )
+    await bus.start()
+    lm = state.load_manager
+    resilience = state.resilience
+    registry = state.registry
+
+    lm.gossip = bus
+    bus.subscribe("tps", lambda d, m: lm.apply_remote_tps(
+        d["eid"], d["model"], d["kind"], float(d["ema"]),
+        int(d.get("samples", 1)), m["ts"],
+    ))
+    bus.subscribe("tps_clear", lambda d, m: lm.clear_tps_for_endpoint(
+        d["eid"], _publish=False,
+    ))
+    bus.subscribe("affinity", lambda d, m: lm.apply_remote_affinity(
+        d["model"], d["hash"], d["eid"], m["ts"],
+    ))
+    if resilience is not None:
+        resilience.gossip = bus
+        resilience.budget.on_spend = lambda: bus.publish("retry_spend", {})
+        bus.subscribe("breaker", lambda d, m: resilience.apply_remote_breaker(
+            d["eid"], d["to"], float(d.get("remaining_s", 0.0)),
+            d.get("reason"), m["ts"],
+        ))
+        bus.subscribe(
+            "retry_spend",
+            lambda d, m: resilience.budget.note_remote_spend(),
+        )
+    registry.on_mutate = lambda: bus.publish("registry", {})
+    bus.subscribe("registry", lambda d, m: registry.reload())
+    return bus
 
 
 def _seed_tps_from_daily_stats(state: AppState) -> None:
@@ -196,6 +418,110 @@ def _seed_tps_from_daily_stats(state: AppState) -> None:
                 r["endpoint_id"], r["model"], kind, tps,
                 samples=r["request_count"],
             )
+
+
+def gateway_exposition(state: AppState) -> str:
+    """The gateway's full Prometheus text exposition: GatewayMetrics series
+    plus scrape-time figures owned by the balancer, admission queue, event
+    bus, and (multi-worker) the gossip bus."""
+    affinity = state.load_manager.affinity_stats()
+    counters = {
+        "llmlb_gateway_dropped_events_total":
+            state.events.dropped_events_total(),
+        "llmlb_gateway_prefix_affinity_hits_total": affinity["hits_total"],
+        "llmlb_gateway_prefix_affinity_misses_total":
+            affinity["misses_total"],
+        "llmlb_gateway_prefix_affinity_evictions_total":
+            affinity["evictions_total"],
+    }
+    gauges = {
+        "llmlb_gateway_active_requests": state.load_manager.total_active(),
+        "llmlb_gateway_admission_queue_depth": state.admission.queue_depth(),
+        "llmlb_gateway_traces_buffered": len(state.traces),
+        "llmlb_gateway_prefix_affinity_entries": affinity["entries"],
+    }
+    if state.gossip is not None:
+        gs = state.gossip.stats()
+        counters["llmlb_gateway_gossip_messages_sent_total"] = gs["sent_total"]
+        counters["llmlb_gateway_gossip_messages_received_total"] = (
+            gs["received_total"]
+        )
+        counters["llmlb_gateway_gossip_send_errors_total"] = (
+            gs["send_errors_total"]
+        )
+        if gs["lag_s"] is not None:
+            gauges["llmlb_gateway_gossip_lag_seconds"] = round(gs["lag_s"], 6)
+    return state.metrics.render(counters=counters, gauges=gauges)
+
+
+# Each worker spools its worker-labeled exposition to a shared file this
+# often; the worker that receives a /metrics scrape (SO_REUSEPORT picks one
+# arbitrarily) merges its siblings' spools, so Prometheus always sees the
+# whole group no matter which accept queue the scrape landed in.
+METRICS_SPOOL_DEFAULT_S = 5.0
+
+
+def _metrics_spool_path(state: AppState, index: int) -> str:
+    return os.path.join(
+        default_gossip_dir(state.config.port), f"metrics-w{index}.prom"
+    )
+
+
+def write_metrics_spool(state: AppState,
+                        labeled_text: str | None = None) -> None:
+    """Spool this worker's worker-labeled exposition for siblings to
+    merge. The /metrics handler passes the text it just rendered so a
+    scrape builds the exposition once, not twice."""
+    from llmlb_tpu.gateway.metrics import label_exposition
+
+    path = _metrics_spool_path(state, state.worker.index)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if labeled_text is None:
+        labeled_text = label_exposition(
+            gateway_exposition(state), "worker", state.worker.label
+        )
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(labeled_text)
+    os.replace(tmp, path)  # atomic: a scrape never reads a torn file
+
+
+def read_peer_metrics(state: AppState, max_age_s: float) -> str:
+    """Concatenated sibling expositions (comment lines stripped — the
+    serving worker's own exposition already declared the families; Prom
+    treats the peers' samples as additional series via their worker
+    label). Stale spools (dead worker) age out instead of freezing."""
+    import glob as _glob
+
+    own = _metrics_spool_path(state, state.worker.index)
+    parts: list[str] = []
+    now = time.time()
+    for path in sorted(_glob.glob(
+        os.path.join(default_gossip_dir(state.config.port), "metrics-w*.prom")
+    )):
+        if path == own:
+            continue
+        try:
+            if now - os.path.getmtime(path) > max_age_s:
+                continue
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        parts.append("\n".join(
+            line for line in text.splitlines()
+            if line and not line.startswith("#")
+        ))
+    return ("\n".join(parts) + "\n") if parts else ""
+
+
+async def _metrics_spool_loop(state: AppState, interval_s: float) -> None:
+    while True:
+        try:
+            write_metrics_spool(state)
+        except Exception:
+            log.exception("metrics spool write failed")
+        await asyncio.sleep(interval_s)
 
 
 async def _maintenance_loop(state: AppState) -> None:
@@ -225,17 +551,7 @@ def record_daily_stat(
     duration_ms: float = 0.0,
 ) -> None:
     today = datetime.date.today().isoformat()
-    state.db.execute(
-        """INSERT INTO endpoint_daily_stats
-           (endpoint_id, date, model, api_kind, request_count, error_count,
-            prompt_tokens, completion_tokens, total_duration_ms)
-           VALUES (?,?,?,?,1,?,?,?,?)
-           ON CONFLICT(endpoint_id, date, model, api_kind) DO UPDATE SET
-               request_count = request_count + 1,
-               error_count = error_count + excluded.error_count,
-               prompt_tokens = prompt_tokens + excluded.prompt_tokens,
-               completion_tokens = completion_tokens + excluded.completion_tokens,
-               total_duration_ms = total_duration_ms + excluded.total_duration_ms""",
+    state.history.add_daily(
         (endpoint_id, today, model, api_kind.value, int(error),
          prompt_tokens, completion_tokens, duration_ms),
     )

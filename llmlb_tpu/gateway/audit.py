@@ -122,28 +122,35 @@ class AuditLog:
         return row["batch_hash"] if row else GENESIS_HASH
 
     def flush(self) -> int | None:
-        """Write pending entries as one chained batch; returns batch id."""
+        """Write pending entries as one chained batch; returns batch id.
+
+        The prev-hash read and the batch insert run in one BEGIN IMMEDIATE
+        transaction: with N gateway workers appending to one WAL file, two
+        concurrent flushes would otherwise both read the same chain head and
+        fork the hash chain (verify() would flag the second batch forever).
+        """
         if not self._pending:
             return None
         entries, self._pending = self._pending, []
-        prev = self._last_hash()
-        digest = batch_hash(prev, entries)
-        cur = self.db.execute(
-            """INSERT INTO audit_batches (batch_hash, prev_hash, entry_count,
-               created_at) VALUES (?,?,?,?)""",
-            (digest, prev, len(entries), time.time()),
-        )
-        batch_id = cur.lastrowid
-        self.db.executemany(
-            """INSERT INTO audit_log (ts, method, path, status, duration_ms,
-               actor, actor_type, ip, detail, batch_id)
-               VALUES (?,?,?,?,?,?,?,?,?,?)""",
-            [
-                (e.ts, e.method, e.path, e.status, e.duration_ms, e.actor,
-                 e.actor_type, e.ip, e.detail, batch_id)
-                for e in entries
-            ],
-        )
+        with self.db.transaction():
+            prev = self._last_hash()
+            digest = batch_hash(prev, entries)
+            cur = self.db.execute(
+                """INSERT INTO audit_batches (batch_hash, prev_hash, entry_count,
+                   created_at) VALUES (?,?,?,?)""",
+                (digest, prev, len(entries), time.time()),
+            )
+            batch_id = cur.lastrowid
+            self.db.executemany(
+                """INSERT INTO audit_log (ts, method, path, status, duration_ms,
+                   actor, actor_type, ip, detail, batch_id)
+                   VALUES (?,?,?,?,?,?,?,?,?,?)""",
+                [
+                    (e.ts, e.method, e.path, e.status, e.duration_ms, e.actor,
+                     e.actor_type, e.ip, e.detail, batch_id)
+                    for e in entries
+                ],
+            )
         return batch_id
 
     # ----------------------------------------------------------------- query

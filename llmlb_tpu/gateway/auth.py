@@ -15,6 +15,7 @@ import hashlib
 import hmac
 import json
 import secrets
+import sqlite3
 import time
 import uuid
 
@@ -32,6 +33,18 @@ MIN_PASSWORD_LENGTH = 8
 
 class AuthError(Exception):
     pass
+
+
+def constant_time_equal(a: bytes, b: bytes) -> bool:
+    """Constant-time byte equality for auth tokens/signatures.
+
+    hmac.compare_digest is already compiled constant-time C and beats a
+    ctypes FFI round trip for digest-sized inputs, so it IS the hot path.
+    native/router_core.cpp's ct_equal is the C twin for native-first
+    callers, held bit-compatible by tests/test_native.py's parity case —
+    this wrapper exists so every auth compare goes through one audited
+    entry point rather than ad-hoc == comparisons."""
+    return hmac.compare_digest(a, b)
 
 
 # ---------------------------------------------------------------------- JWT
@@ -92,7 +105,7 @@ def verify_jwt(secret: str, token: str, now: float | None = None) -> dict:
     expected = hmac.new(
         secret.encode(), signing_input.encode(), hashlib.sha256
     ).digest()
-    if not hmac.compare_digest(sig, expected):
+    if not constant_time_equal(sig, expected):
         raise AuthError("invalid JWT signature")
     if payload.get("exp", 0) < now:
         raise AuthError("token expired")
@@ -215,10 +228,18 @@ def ensure_admin_exists(
     if password is None:
         generated = secrets.token_urlsafe(12)
         password = generated
-    user = users.create(
-        username, password, Role.ADMIN,
-        must_change_password=generated is not None, enforce_policy=False,
-    )
+    try:
+        user = users.create(
+            username, password, Role.ADMIN,
+            must_change_password=generated is not None, enforce_policy=False,
+        )
+    except (AuthError, sqlite3.IntegrityError):
+        # multi-worker boot race: a sibling worker created the admin between
+        # our existence check and the INSERT — adopt its row
+        existing = users.get_by_username(username)
+        if existing:
+            return existing, None
+        raise
     return user, generated
 
 
@@ -243,8 +264,43 @@ def _hash_key(raw: str) -> str:
 
 
 class ApiKeyStore:
-    def __init__(self, db: Database):
+    """API keys, stored as SHA-256 hashes.
+
+    ``LLMLB_AUTH_CACHE_TTL`` (seconds) enables an in-memory verified-key
+    cache: the proxy hot path then skips one SELECT and one last_used_at
+    UPDATE per request. The price is bounded revocation latency — a
+    revoked key keeps working for up to the TTL on workers other than the
+    one that served the revoke (which invalidates its own cache
+    immediately). Default: 0 (off, bit-identical historical behavior) for
+    a single-worker gateway; 60 s with --workers > 1 — N workers must not
+    serialize on the shared WAL writer lock once per request just to
+    refresh a dashboard timestamp (docs/deployment.md). The env knob
+    overrides either default (0 disables explicitly).
+    """
+
+    MULTI_WORKER_DEFAULT_TTL_S = 60.0
+
+    def __init__(self, db: Database, cache_ttl_s: float | None = None):
+        import threading
+
         self.db = db
+        if cache_ttl_s is None:
+            # standalone construction (scripts, tests): fall back to the
+            # env-derived worker identity; build_app_state passes the TTL
+            # explicitly from ITS WorkerInfo so in-process multi-worker
+            # states agree with forked ones
+            from llmlb_tpu.gateway.config import env_float
+            from llmlb_tpu.gateway.worker import current_worker
+
+            cache_ttl_s = env_float(
+                "LLMLB_AUTH_CACHE_TTL",
+                self.MULTI_WORKER_DEFAULT_TTL_S
+                if current_worker().multi else 0.0,
+            )
+        self.cache_ttl_s = cache_ttl_s
+        self._cache_lock = threading.Lock()
+        # key_hash -> (ApiKey, cached_at, last_used_written_at)
+        self._cache: dict[str, tuple[ApiKey, float, float]] = {}
 
     def create(
         self, user_id: str, name: str, permissions: list[Permission],
@@ -267,17 +323,42 @@ class ApiKeyStore:
         )
 
     def verify(self, raw: str) -> ApiKey | None:
+        key_hash = _hash_key(raw)
+        now = time.time()
+        ttl = self.cache_ttl_s
+        if ttl > 0:
+            with self._cache_lock:
+                got = self._cache.get(key_hash)
+            if got is not None:
+                key, cached_at, used_written_at = got
+                if now - cached_at < ttl:
+                    if key.expires_at is not None and key.expires_at < now:
+                        return None
+                    if now - used_written_at >= ttl:
+                        # last_used_at is dashboard telemetry; once per TTL
+                        # keeps it honest without a write per request
+                        self.db.execute(
+                            "UPDATE api_keys SET last_used_at=? WHERE id=?",
+                            (now, key.id),
+                        )
+                        with self._cache_lock:
+                            self._cache[key_hash] = (key, cached_at, now)
+                    return key
         row = self.db.query_one(
-            "SELECT * FROM api_keys WHERE key_hash=?", (_hash_key(raw),)
+            "SELECT * FROM api_keys WHERE key_hash=?", (key_hash,)
         )
         if row is None or row["revoked"]:
             return None
-        if row["expires_at"] is not None and row["expires_at"] < time.time():
+        if row["expires_at"] is not None and row["expires_at"] < now:
             return None
         self.db.execute(
-            "UPDATE api_keys SET last_used_at=? WHERE id=?", (time.time(), row["id"])
+            "UPDATE api_keys SET last_used_at=? WHERE id=?", (now, row["id"])
         )
-        return self._to_key(row)
+        key = self._to_key(row)
+        if ttl > 0:
+            with self._cache_lock:
+                self._cache[key_hash] = (key, now, now)
+        return key
 
     def list(self, user_id: str | None = None) -> list[ApiKey]:
         if user_id:
@@ -292,6 +373,12 @@ class ApiKeyStore:
         cur = self.db.execute(
             "UPDATE api_keys SET revoked=1 WHERE id=?", (key_id,)
         )
+        with self._cache_lock:
+            # this worker stops honoring the key immediately; siblings age
+            # it out within the cache TTL
+            for key_hash, (key, _, _) in list(self._cache.items()):
+                if key.id == key_id:
+                    del self._cache[key_hash]
         return cur.rowcount > 0
 
     @staticmethod

@@ -19,8 +19,19 @@ Behavior parity with the reference scheduler (reference balancer/mod.rs):
 - Prefix-affinity routing (no reference counterpart): requests whose prompt
   head hashes to a recently-routed prefix stick to the endpoint that last
   served it, so the engine-side prefix KV cache (engine/prefix_cache.py)
-  actually gets hit; bounded LRU map with TTL, falls back to normal scoring
-  whenever the sticky endpoint is unhealthy, absent, or at its cap.
+  actually gets hit. Two modes (LLMLB_AFFINITY):
+    * ``lru`` (default single-worker): learned bounded LRU map with TTL —
+      the historical behavior, bit-identical to pre-multi-worker gateways.
+    * ``ring`` (default with --workers > 1): rendezvous/consistent hashing
+      over the live endpoint set — every worker maps the same prompt head
+      to the same endpoint with zero coordination, steering survives worker
+      restarts, and endpoint churn remaps only ~1/E of keys.
+  Both fall back to normal scoring whenever the sticky endpoint is
+  unhealthy, absent, or at its cap.
+- Gossip replication (gateway/gossip.py, multi-worker): TPS EMA samples and
+  (in lru mode) affinity pins publish to sibling workers and apply
+  last-writer-wins; a worker that misses updates only places requests
+  slightly worse until its own measurements converge.
 """
 
 from __future__ import annotations
@@ -76,6 +87,52 @@ def prefix_affinity_hash(model: str, text: str) -> str | None:
     return hashlib.sha1(
         f"{model}\x00{head}".encode("utf-8", "replace")
     ).hexdigest()
+
+
+# Gossip: one TPS message per tracked key at most this often — the EMA moves
+# slowly, and per-request fan-out would put a datagram on the bus for every
+# completion.
+TPS_GOSSIP_MIN_INTERVAL_S = 1.0
+
+AFFINITY_MODES = ("lru", "ring")
+
+
+def hrw_weight(prefix_hash: str, endpoint_id: str) -> int:
+    """Rendezvous (highest-random-weight) score of one (key, endpoint)
+    pair: the first 8 bytes of sha256("hash|endpoint") as a big-endian
+    integer. The native twin (router_core.cpp hrw_select) computes the
+    same bytes, so Python and C++ agree bit for bit."""
+    digest = hashlib.sha256(
+        f"{prefix_hash}|{endpoint_id}".encode()
+    ).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def hrw_owner(prefix_hash: str, endpoint_ids: list[str]) -> str | None:
+    """Consistent-hash owner of a prefix over the live endpoint set.
+
+    Pure function of (key, set): every worker computes the same owner with
+    no shared state, a restart loses nothing, and removing one endpoint
+    remaps only the keys it owned (~1/E) — the property a mod-N hash lacks.
+    Ties (astronomically unlikely) break toward the smallest endpoint id.
+    """
+    best: str | None = None
+    best_w = -1
+    for eid in endpoint_ids:
+        w = hrw_weight(prefix_hash, eid)
+        if w > best_w or (w == best_w and (best is None or eid < best)):
+            best, best_w = eid, w
+    return best
+
+
+def default_affinity_mode(worker_count: int = 1) -> str:
+    """LLMLB_AFFINITY beats the worker-count default: ring when several
+    workers must agree without coordination, lru (the historical,
+    bit-identical behavior) for a single worker."""
+    raw = (os.environ.get("LLMLB_AFFINITY") or "").strip().lower()
+    if raw in AFFINITY_MODES:
+        return raw
+    return "ring" if worker_count > 1 else "lru"
 
 
 def telemetry_penalty(ep: Endpoint, now: float | None = None) -> float:
@@ -178,8 +235,16 @@ class RequestLease:
 
 class LoadManager:
     def __init__(self, queue_config: QueueConfig | None = None,
-                 use_native: bool | None = None):
+                 use_native: bool | None = None,
+                 affinity_mode: str | None = None):
         self.queue_config = queue_config or QueueConfig()
+        self.affinity_mode = (affinity_mode if affinity_mode in AFFINITY_MODES
+                              else default_affinity_mode())
+        # GossipBus | None (set by app_state in multi-worker mode): TPS
+        # samples and lru-mode affinity pins replicate to sibling workers.
+        # Advisory only — every consumer of this state tolerates staleness.
+        self.gossip = None
+        self._tps_pub_ts: dict[tuple[str, str, str], float] = {}
         self._lock = threading.Lock()
         # (endpoint_id, model, api_kind) -> ModelTpsState
         self._tps: dict[tuple[str, str, str], ModelTpsState] = {}
@@ -213,6 +278,7 @@ class LoadManager:
         # path below (tested side by side). Python remains the fallback and
         # the behavioral reference. LLMLB_NATIVE_ROUTER=0 disables.
         self._rc = None
+        self._hrw_native = None
         if use_native is None:
             use_native = os.environ.get(
                 "LLMLB_NATIVE_ROUTER", "1"
@@ -224,6 +290,13 @@ class LoadManager:
                 self._rc = NativeRouterCore(TPS_EMA_ALPHA)
             except (RuntimeError, OSError):
                 self._rc = None
+            try:
+                from llmlb_tpu.native import native_hrw_available, native_hrw_select
+
+                if native_hrw_available():
+                    self._hrw_native = native_hrw_select
+            except ImportError:
+                self._hrw_native = None
 
     # ------------------------------------------------------------------- TPS
 
@@ -234,6 +307,7 @@ class LoadManager:
         if self._rc is not None:
             self._rc.update_tps(endpoint_id, model, api_kind.value,
                                 tokens, duration_s, time.time())
+            self._maybe_gossip_tps(endpoint_id, model, api_kind.value)
             return
         if duration_s <= 0 or tokens <= 0:
             return  # rejected samples must not create phantom tracked keys
@@ -241,6 +315,56 @@ class LoadManager:
             key = (endpoint_id, model, api_kind.value)
             state = self._tps.setdefault(key, ModelTpsState())
             state.update(tokens, duration_s)
+        self._maybe_gossip_tps(endpoint_id, model, api_kind.value)
+
+    # --------------------------------------------------------- tps replication
+
+    def _tps_info(self, endpoint_id: str, model: str,
+                  kind: str) -> tuple[float, int, float] | None:
+        """(ema, samples, last_update) for one key, whichever core holds it."""
+        if self._rc is not None:
+            return self._rc.tps_info(endpoint_id, model, kind)
+        with self._lock:
+            state = self._tps.get((endpoint_id, model, kind))
+            if state is None or state.samples == 0:
+                return None
+            return state.ema_tps, state.samples, state.last_update
+
+    def _maybe_gossip_tps(self, endpoint_id: str, model: str,
+                          kind: str) -> None:
+        g = self.gossip
+        if g is None:
+            return
+        key = (endpoint_id, model, kind)
+        now = time.monotonic()
+        if now - self._tps_pub_ts.get(key, 0.0) < TPS_GOSSIP_MIN_INTERVAL_S:
+            return
+        self._tps_pub_ts[key] = now
+        info = self._tps_info(endpoint_id, model, kind)
+        if info is None:
+            return
+        ema, samples, _last = info
+        g.publish("tps", {"eid": endpoint_id, "model": model, "kind": kind,
+                          "ema": ema, "samples": samples})
+
+    def apply_remote_tps(self, endpoint_id: str, model: str, kind: str,
+                         ema: float, samples: int, ts: float) -> None:
+        """A sibling worker's EMA, applied last-writer-wins: older than what
+        this worker measured itself is dropped. Never re-gossips."""
+        info = self._tps_info(endpoint_id, model, kind)
+        if info is not None and info[2] >= ts:
+            return
+        if self._rc is not None:
+            self._rc.seed_tps(endpoint_id, model, kind, ema,
+                              max(1, samples), ts)
+            return
+        with self._lock:
+            local = self._tps.get((endpoint_id, model, kind))
+            if local is not None and local.last_update >= ts:
+                return  # re-check under the lock: a racing local sample wins
+            self._tps[(endpoint_id, model, kind)] = ModelTpsState(
+                ema_tps=ema, samples=max(1, samples), last_update=ts
+            )
 
     def seed_tps(self, endpoint_id: str, model: str, api_kind: TpsApiKind,
                  ema_tps: float, samples: int = 1) -> None:
@@ -262,22 +386,27 @@ class LoadManager:
             state = self._tps.get((endpoint_id, model, api_kind.value))
             return state.ema_tps if state and state.samples else None
 
-    def clear_tps_for_endpoint(self, endpoint_id: str) -> None:
+    def clear_tps_for_endpoint(self, endpoint_id: str,
+                               _publish: bool = True) -> None:
         """On failure: a recovered endpoint must re-learn (balancer/mod.rs:1791).
         Prefix affinities pinned to it are dropped too — its engine restarts
         with a cold prefix cache, so stickiness buys nothing and would keep
-        steering shared-prefix traffic at a flapping endpoint."""
+        steering shared-prefix traffic at a flapping endpoint. The clear
+        gossips to sibling workers (the pull checker that noticed the
+        failure runs in one elected worker only)."""
         with self._lock:
             for key in [k for k, (eid, _) in self._affinity.items()
                         if eid == endpoint_id]:
                 del self._affinity[key]
         if self._rc is not None:
             self._rc.clear_endpoint(endpoint_id)
-            return
-        with self._lock:
-            self._tps = {
-                k: v for k, v in self._tps.items() if k[0] != endpoint_id
-            }
+        else:
+            with self._lock:
+                self._tps = {
+                    k: v for k, v in self._tps.items() if k[0] != endpoint_id
+                }
+        if _publish and self.gossip is not None:
+            self.gossip.publish("tps_clear", {"eid": endpoint_id})
 
     def tps_snapshot(self) -> dict[str, dict]:
         if self._rc is not None:
@@ -306,13 +435,43 @@ class LoadManager:
         return endpoint_id
 
     def _affinity_note_locked(self, model: str, prefix_hash: str,
-                              endpoint_id: str) -> None:
+                              endpoint_id: str) -> bool:
+        """Returns True when the pin is new or moved to another endpoint
+        (the only cases worth gossiping — refreshes are noise)."""
         key = (model, prefix_hash)
+        prev = self._affinity.get(key)
         self._affinity[key] = (endpoint_id, time.time())
         self._affinity.move_to_end(key)
         while len(self._affinity) > PREFIX_AFFINITY_CAPACITY:
             self._affinity.popitem(last=False)
             self._affinity_evictions += 1
+        return prev is None or prev[0] != endpoint_id
+
+    def _gossip_affinity(self, model: str, prefix_hash: str,
+                         endpoint_id: str) -> None:
+        if self.gossip is not None:
+            self.gossip.publish("affinity", {
+                "model": model, "hash": prefix_hash, "eid": endpoint_id,
+            })
+
+    def apply_remote_affinity(self, model: str, prefix_hash: str,
+                              endpoint_id: str, ts: float) -> None:
+        """A sibling worker pinned this prefix (lru mode only — ring mode
+        needs no replication, the hash IS the agreement). Stored with the
+        remote stamp so TTL expiry and last-writer-wins stay consistent;
+        never counted as hit/miss, never re-gossiped."""
+        if self.affinity_mode != "lru":
+            return
+        with self._lock:
+            key = (model, prefix_hash)
+            cur = self._affinity.get(key)
+            if cur is not None and cur[1] >= ts:
+                return
+            self._affinity[key] = (endpoint_id, ts)
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > PREFIX_AFFINITY_CAPACITY:
+                self._affinity.popitem(last=False)
+                self._affinity_evictions += 1
 
     def _affinity_endpoint(self, model: str,
                            prefix_hash: str | None) -> str | None:
@@ -321,16 +480,40 @@ class LoadManager:
         with self._lock:
             return self._affinity_peek_locked(model, prefix_hash)
 
+    def _hrw_owner(self, prefix_hash: str, endpoint_ids: list[str]) -> str | None:
+        if self._hrw_native is not None:
+            idx = self._hrw_native(prefix_hash, endpoint_ids)
+            if 0 <= idx < len(endpoint_ids):
+                return endpoint_ids[idx]
+            return None
+        return hrw_owner(prefix_hash, endpoint_ids)
+
+    def _sticky_endpoint_id(self, endpoints: list[Endpoint], model: str,
+                            prefix_hash: str | None) -> str | None:
+        """The endpoint this prefix should steer to, by affinity mode: the
+        learned LRU pin, or the consistent-hash owner over the candidate
+        set (post-breaker, pre-cap)."""
+        if prefix_hash is None:
+            return None
+        if self.affinity_mode == "ring":
+            return self._hrw_owner(prefix_hash, [ep.id for ep in endpoints])
+        return self._affinity_endpoint(model, prefix_hash)
+
     def _affinity_record(self, model: str, prefix_hash: str | None,
                          endpoint_id: str, *, hit: bool) -> None:
         if prefix_hash is None:
             return
+        changed = False
         with self._lock:
-            self._affinity_note_locked(model, prefix_hash, endpoint_id)
+            if self.affinity_mode == "lru":
+                changed = self._affinity_note_locked(model, prefix_hash,
+                                                     endpoint_id)
             if hit:
                 self._affinity_hits += 1
             else:
                 self._affinity_misses += 1
+        if changed:
+            self._gossip_affinity(model, prefix_hash, endpoint_id)
 
     def affinity_stats(self) -> dict:
         """Prefix-affinity figures for the gateway /metrics exposition."""
@@ -430,10 +613,10 @@ class LoadManager:
 
     def _affinity_sticky_rc(self, endpoints: list[Endpoint], model: str,
                             prefix_hash: str | None) -> Endpoint | None:
-        """Native-router path: the affinity map lives on the Python side, so
-        steer before delegating to the C++ scorer. Only honors an endpoint
+        """Native-router path: affinity (LRU map or consistent-hash owner)
+        steers before delegating to the C++ scorer. Only honors an endpoint
         that is still a candidate and under its admission cap."""
-        eid = self._affinity_endpoint(model, prefix_hash)
+        eid = self._sticky_endpoint_id(endpoints, model, prefix_hash)
         if eid is None:
             return None
         cap = self.queue_config.max_active_per_endpoint
@@ -466,12 +649,25 @@ class LoadManager:
             return None
 
         if prefix_hash is not None:
-            sticky_id = self._affinity_peek_locked(model, prefix_hash)
-            for ep in candidates:
-                if ep.id == sticky_id:
-                    self._affinity_note_locked(model, prefix_hash, ep.id)
-                    self._affinity_hits += 1
-                    return ep
+            if self.affinity_mode == "ring":
+                # Consistent-hash owner over the permitted set (not just the
+                # under-cap candidates): an at-cap owner counts a miss and
+                # falls through to scoring rather than silently remapping —
+                # the key snaps back the moment capacity frees.
+                owner = self._hrw_owner(prefix_hash,
+                                        [ep.id for ep in endpoints])
+                for ep in candidates:
+                    if ep.id == owner:
+                        self._affinity_hits += 1
+                        return ep
+                self._affinity_misses += 1
+            else:
+                sticky_id = self._affinity_peek_locked(model, prefix_hash)
+                for ep in candidates:
+                    if ep.id == sticky_id:
+                        self._affinity_note_locked(model, prefix_hash, ep.id)
+                        self._affinity_hits += 1
+                        return ep
 
         now = time.time()
         scored: list[tuple[float, float, Endpoint]] = []
@@ -494,9 +690,13 @@ class LoadManager:
         idx = self._rr_counter[model] % len(top)
         self._rr_counter[model] += 1
         chosen = top[idx][1]
-        if prefix_hash is not None:
-            self._affinity_note_locked(model, prefix_hash, chosen.id)
+        if prefix_hash is not None and self.affinity_mode == "lru":
+            changed = self._affinity_note_locked(model, prefix_hash, chosen.id)
             self._affinity_misses += 1
+            if changed:
+                # publish-under-lock is safe: gossip sends are non-blocking
+                # datagram writes, never an event-loop round trip
+                self._gossip_affinity(model, prefix_hash, chosen.id)
         return chosen
 
     def try_admit(
@@ -510,7 +710,7 @@ class LoadManager:
         if not endpoints:
             return None
         if self._rc is not None:
-            eid = self._affinity_endpoint(model, prefix_hash)
+            eid = self._sticky_endpoint_id(endpoints, model, prefix_hash)
             sticky = next((ep for ep in endpoints if ep.id == eid), None)
             if sticky is not None:
                 # atomic cap-check + begin in the native core, scoped to the

@@ -231,6 +231,35 @@ def _caps_from_json(raw: str | None) -> list[Capability]:
     return out
 
 
+class _Transaction:
+    """BEGIN IMMEDIATE transaction holding the connection lock for its whole
+    extent (re-entrant: accessors called inside still acquire it)."""
+
+    def __init__(self, db: "Database"):
+        self.db = db
+
+    def __enter__(self) -> "Database":
+        self.db._lock.acquire()
+        try:
+            self.db._conn.execute("BEGIN IMMEDIATE")
+        except BaseException:
+            # BEGIN can itself time out on a sibling process's write lock;
+            # __exit__ will never run, so release here or the RLock leaks
+            # and every later caller deadlocks
+            self.db._lock.release()
+            raise
+        return self.db
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None:
+                self.db._conn.execute("COMMIT")
+            else:
+                self.db._conn.execute("ROLLBACK")
+        finally:
+            self.db._lock.release()
+
+
 class Database:
     """Thread-safe SQLite wrapper with typed accessors."""
 
@@ -241,9 +270,32 @@ class Database:
         )
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA foreign_keys=ON")
+        # Multi-worker serving (gateway/worker.py) gives each process its
+        # own connection to one WAL file; writers queue on the file lock
+        # instead of throwing SQLITE_BUSY at the first collision.
+        self._conn.execute("PRAGMA busy_timeout=5000")
+        # WAL + synchronous=NORMAL is the documented SQLite pairing: commits
+        # skip the per-transaction fsync (the WAL is still fsynced at
+        # checkpoint), which is the difference between request-path writes
+        # costing ~µs and costing a disk flush each. Durability window on
+        # power loss is the last checkpoint — request history/stats, not
+        # ledger data.
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._lock = threading.RLock()
         with self._lock:
-            self._conn.executescript(SCHEMA)
+            # N forked workers initialize the same file concurrently at
+            # boot; executescript's implicit transaction handling can
+            # surface SQLITE_BUSY despite busy_timeout (the WAL-mode switch
+            # needs a moment of exclusivity), so schema init retries
+            # briefly instead of killing the worker.
+            for attempt in range(50):
+                try:
+                    self._conn.executescript(SCHEMA)
+                    break
+                except sqlite3.OperationalError as e:
+                    if "locked" not in str(e) or attempt == 49:
+                        raise
+                    time.sleep(0.1)
             try:
                 # Backfill on upgrade: a DB that predates the FTS table has
                 # unindexed rows — searches would miss them and the delete
@@ -274,6 +326,13 @@ class Database:
     def execute(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
         with self._lock:
             return self._conn.execute(sql, params)
+
+    def transaction(self):
+        """Context manager: BEGIN IMMEDIATE ... COMMIT under the connection
+        lock, so a read-then-write sequence (the audit chain's prev-hash
+        read + batch insert) is atomic against sibling worker processes,
+        not just sibling threads."""
+        return _Transaction(self)
 
     def executemany(self, sql: str, rows: list[tuple]) -> None:
         with self._lock:

@@ -1,0 +1,214 @@
+"""Best-effort state replication between gateway workers on one host.
+
+Shared-nothing workers (gateway/worker.py) each hold their own copy of the
+small mutable routing state: breaker states, TPS EMAs, the retry-budget
+window, and (in LRU mode) prefix-affinity pins. This bus gossips those
+deltas over local unix datagram sockets so a breaker tripped by one worker
+ejects the endpoint on all of them within ~1 RTT, and a TPS sample measured
+by one worker steers its siblings too.
+
+Design constraints, in order:
+  * **Correctness never depends on gossip.** Every message is advisory: a
+    worker that misses updates only degrades steering/placement until its
+    own in-band signals converge (LLMLB_GOSSIP=0 must be a safe mode).
+  * **Last-writer-wins.** Messages carry a wall-clock stamp; receivers drop
+    anything older than the state they already hold. Same-host wall clocks
+    make this exact enough for ~millisecond propagation.
+  * **Never block the hot path.** Sends are non-blocking datagram writes to
+    every peer socket; a full or missing peer socket drops the message
+    (counted) instead of waiting.
+
+Each worker binds ``{dir}/w{index}.sock`` and publishes by iterating the
+other ``w*.sock`` files in the directory — no membership protocol; a dead
+worker's stale socket just eats an ECONNREFUSED (counted as a drop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import typing
+
+log = logging.getLogger("llmlb_tpu.gateway.gossip")
+
+# Re-list the peer sockets at most this often: publishes between refreshes
+# reuse the cached listing (workers churn at process granularity, not per
+# request).
+PEER_REFRESH_S = 2.0
+
+# Tolerated message staleness: a datagram older than this is counted as a
+# lag outlier but still applied (LWW stamps do per-key ordering).
+LAG_WINDOW = 64  # samples kept for the lag gauge
+
+
+class _Receiver(asyncio.DatagramProtocol):
+    def __init__(self, bus: "GossipBus"):
+        self.bus = bus
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.bus._on_datagram(data)
+
+
+class GossipBus:
+    """Unix-datagram fan-out between the workers of one gateway instance.
+
+    Handlers are registered per message kind and run on the receiving
+    worker's event loop; they must be fast and must NOT publish back
+    (receivers apply remote state via ``apply_remote_*`` entry points that
+    never re-gossip, or a two-worker group would ping-pong forever).
+    """
+
+    def __init__(self, directory: str, index: int, expected_peers: int = 0):
+        self.directory = directory
+        self.index = index
+        # Sibling count this bus should eventually see: while the cached
+        # listing is SHORTER than this, every publish re-globs — a worker
+        # that boots milliseconds before its siblings must not cache the
+        # empty directory for PEER_REFRESH_S and silently drop its first
+        # (often most important: registry/breaker) messages.
+        self.expected_peers = expected_peers
+        self.path = os.path.join(directory, f"w{index}.sock")
+        self._handlers: dict[str, list[typing.Callable]] = {}
+        self._send_sock: socket.socket | None = None
+        self._transport: asyncio.DatagramTransport | None = None
+        self._peers: list[str] = []
+        self._peers_refreshed = 0.0
+        self._lock = threading.Lock()
+        # counters surfaced in /metrics (docs/monitoring/README.md)
+        self.sent_total = 0
+        self.received_total = 0
+        self.send_errors_total = 0
+        self._lag_samples: list[float] = []
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        try:
+            os.unlink(self.path)  # stale socket from a previous run
+        except FileNotFoundError:
+            pass
+        recv = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        recv.bind(self.path)
+        recv.setblocking(False)
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Receiver(self), sock=recv
+        )
+        send = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        send.setblocking(False)
+        self._send_sock = send
+        log.info("gossip bus up at %s", self.path)
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+        if self._send_sock is not None:
+            self._send_sock.close()
+            self._send_sock = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ publishing
+
+    def _peer_paths(self) -> list[str]:
+        now = time.monotonic()
+        if (now - self._peers_refreshed > PEER_REFRESH_S
+                or len(self._peers) < self.expected_peers):
+            self._peers = [
+                p for p in glob.glob(os.path.join(self.directory, "w*.sock"))
+                if p != self.path
+            ]
+            self._peers_refreshed = now
+        return self._peers
+
+    def publish(self, kind: str, data: dict) -> None:
+        """Fire-and-forget to every peer. Callable from any thread (lease
+        releases arrive from GC finalizers); plain sendto on a non-blocking
+        datagram socket, no event-loop round trip."""
+        sock = self._send_sock
+        if sock is None:
+            return
+        payload = json.dumps(
+            {"k": kind, "src": self.index, "ts": time.time(), "d": data},
+            separators=(",", ":"),
+        ).encode()
+        with self._lock:
+            peers = self._peer_paths()
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug("gossip publish kind=%s to %d peers", kind,
+                          len(peers))
+            for peer in peers:
+                try:
+                    sock.sendto(payload, peer)
+                    self.sent_total += 1
+                except OSError:
+                    # peer gone / queue full: best-effort means drop, and
+                    # the peer's own in-band signals converge it later
+                    self.send_errors_total += 1
+
+    # -------------------------------------------------------------- receiving
+
+    def subscribe(self, kind: str, handler: typing.Callable[[dict, dict], None]) -> None:
+        """``handler(data, meta)`` with meta = {src, ts, lag_s}."""
+        self._handlers.setdefault(kind, []).append(handler)
+
+    def _on_datagram(self, raw: bytes) -> None:
+        try:
+            msg = json.loads(raw)
+            kind = msg["k"]
+            ts = float(msg["ts"])
+        except (ValueError, KeyError, TypeError):
+            return
+        self.received_total += 1
+        lag = max(0.0, time.time() - ts)
+        self._lag_samples.append(lag)
+        if len(self._lag_samples) > LAG_WINDOW:
+            del self._lag_samples[: len(self._lag_samples) - LAG_WINDOW]
+        meta = {"src": msg.get("src"), "ts": ts, "lag_s": lag}
+        for handler in self._handlers.get(kind, ()):
+            try:
+                handler(msg.get("d") or {}, meta)
+            except Exception:  # one bad handler must not poison the bus
+                log.exception("gossip handler for %r failed", kind)
+
+    # ------------------------------------------------------------- inspection
+
+    def lag_seconds(self) -> float | None:
+        """Mean one-way delay of recently received messages (the gossip-lag
+        gauge); None until the first message arrives."""
+        if not self._lag_samples:
+            return None
+        return sum(self._lag_samples) / len(self._lag_samples)
+
+    def stats(self) -> dict:
+        with self._lock:
+            peers = len(self._peer_paths())
+        return {
+            "sent_total": self.sent_total,
+            "received_total": self.received_total,
+            "send_errors_total": self.send_errors_total,
+            "lag_s": self.lag_seconds(),
+            "peers": peers,
+        }
+
+
+def default_gossip_dir(port: int) -> str:
+    """One bus per gateway instance: scope the socket dir by listen port so
+    two gateways on one host never cross-gossip."""
+    base = os.environ.get("LLMLB_GOSSIP_DIR")
+    if base:
+        return base
+    data_dir = os.path.expanduser(
+        os.environ.get("LLMLB_DATA_DIR", "~/.llmlb") or "~/.llmlb"
+    )
+    return os.path.join(data_dir, "gossip", str(port))

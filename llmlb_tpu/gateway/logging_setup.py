@@ -18,7 +18,36 @@ import os
 LOG_FILENAME = "llmlb.log"
 DEFAULT_RETENTION = 14  # rotated files kept, parity with cleanup loop
 
+# Every line carries the worker id (``w0`` .. ``wN-1``): with --workers N
+# the processes' stderr interleaves on one console, and an untagged line
+# from worker 3 is indistinguishable from worker 0's. Override with
+# LLMLB_LOG_FORMAT (standard logging %-format; the extra field is
+# ``%(worker)s``). Documented in docs/configuration.md.
+DEFAULT_LOG_FORMAT = (
+    "%(asctime)s %(levelname)-7s w%(worker)s %(name)s: %(message)s"
+)
+
 _active_log_path: str | None = None
+_factory_installed = False
+
+
+def _install_worker_field() -> None:
+    """Stamp every LogRecord with this process's worker index via the
+    record factory (handler filters would miss records emitted before the
+    handlers exist, and third-party handlers added later)."""
+    global _factory_installed
+    if _factory_installed:
+        return
+    _factory_installed = True
+    old_factory = logging.getLogRecordFactory()
+
+    def factory(*args, **kwargs):
+        record = old_factory(*args, **kwargs)
+        if not hasattr(record, "worker"):
+            record.worker = os.environ.get("LLMLB_WORKER_INDEX", "0")
+        return record
+
+    logging.setLogRecordFactory(factory)
 
 
 def default_log_dir() -> str:
@@ -54,10 +83,11 @@ def init_logging(
         os.environ.get("LLMLB_LOG_RETENTION", DEFAULT_RETENTION)
     )
 
+    _install_worker_field()
     root = logging.getLogger()
     root.setLevel(log_level)
     fmt = logging.Formatter(
-        "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+        os.environ.get("LLMLB_LOG_FORMAT") or DEFAULT_LOG_FORMAT
     )
 
     for h in list(root.handlers):

@@ -36,10 +36,46 @@ drops, trace-buffer size) injected by the /metrics handler.
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import defaultdict
 
 from llmlb_tpu.engine.metrics import Histogram
+
+# Sample lines of a Prometheus text exposition: `name value`,
+# `name{labels} value`, with optional trailing timestamp. The label block
+# is matched greedily to the LAST closing brace before the value — a '}'
+# inside a label value (legal; only \ " \n are escaped) must not truncate
+# the block or the injected label would land mid-string.
+_SAMPLE_LINE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?( .*)$"
+)
+
+
+def label_exposition(text: str, label: str, value: str) -> str:
+    """Inject one label into every sample line of an exposition.
+
+    Multi-worker /metrics: each worker's series carry worker="N" so a
+    scrape (which SO_REUSEPORT hands to ONE arbitrary worker) stays
+    attributable after the serving worker merges its siblings' spooled
+    expositions — sum by (...) in PromQL aggregates, by (worker) splits.
+    """
+    pair = f'{label}="{value}"'
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_LINE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, rest = m.group(1), m.group(2), m.group(3)
+        if labels:
+            out.append(f"{name}{{{labels[1:-1]},{pair}}}{rest}")
+        else:
+            out.append(f"{name}{{{pair}}}{rest}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
 
 # Gateway-side latency edges: TTFT spans engine prefill plus proxy overhead
 # (tens of ms to tens of seconds for queued long prompts); queue wait spans

@@ -94,6 +94,24 @@ def _configure(lib: ctypes.CDLL) -> None:
         ]
         lib.rc_snapshot.restype = c.c_int64
         lib.rc_snapshot.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    if hasattr(lib, "rc_tps_info"):
+        lib.rc_tps_info.restype = c.c_int32
+        lib.rc_tps_info.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_char_p, c.c_char_p,
+            c.POINTER(c.c_double), c.POINTER(c.c_int64),
+            c.POINTER(c.c_double),
+        ]
+    # Consistent-hash owner + constant-time compare (proxy hot path) —
+    # optional like the router core: stale .so builds lack them and the
+    # Python twins stay behaviorally identical.
+    if hasattr(lib, "hrw_select"):
+        lib.hrw_select.restype = c.c_int64
+        lib.hrw_select.argtypes = [
+            c.c_char_p, c.POINTER(c.c_char_p), c.c_int64,
+        ]
+    if hasattr(lib, "ct_equal"):
+        lib.ct_equal.restype = c.c_int32
+        lib.ct_equal.argtypes = [c.c_char_p, c.c_int64, c.c_char_p, c.c_int64]
 
     lib.sse_new.restype = c.c_void_p
     lib.sse_feed.restype = None
@@ -270,6 +288,36 @@ class NativeSseScanner:
             self._handle = None
 
 
+# ------------------------------------------------- hot-path micro primitives
+
+
+def native_hrw_available() -> bool:
+    lib = load_native()
+    return lib is not None and hasattr(lib, "hrw_select")
+
+
+def native_hrw_select(key: str, endpoint_ids: list[str]) -> int:
+    """Index of the consistent-hash (rendezvous) owner of `key` among
+    `endpoint_ids`; -1 for an empty list. Bit-identical to
+    balancer.hrw_owner — tested side by side."""
+    lib = load_native()
+    n = len(endpoint_ids)
+    if lib is None or not hasattr(lib, "hrw_select") or n == 0:
+        return -1
+    arr = (ctypes.c_char_p * n)(*[e.encode() for e in endpoint_ids])
+    return lib.hrw_select(key.encode(), arr, n)
+
+
+def native_ct_equal(a: bytes, b: bytes) -> bool | None:
+    """Constant-time byte equality in compiled code; None when the native
+    library (or symbol) is unavailable — callers fall back to
+    hmac.compare_digest."""
+    lib = load_native()
+    if lib is None or not hasattr(lib, "ct_equal"):
+        return None
+    return bool(lib.ct_equal(a, len(a), b, len(b)))
+
+
 # ---------------------------------------------------------------- router core
 
 
@@ -305,6 +353,23 @@ class NativeRouterCore:
             self._handle, eid.encode(), model.encode(), kind.encode()
         )
         return None if v < 0 else v
+
+    def tps_info(self, eid: str, model: str,
+                 kind: str) -> tuple[float, int, float] | None:
+        """(ema, samples, last_update) or None when unmeasured — feeds the
+        cross-worker TPS gossip (publish + last-writer-wins compare)."""
+        if not hasattr(self._lib, "rc_tps_info"):
+            return None  # stale .so: gossip publish just skips this key
+        ema = ctypes.c_double()
+        samples = ctypes.c_int64()
+        last = ctypes.c_double()
+        got = self._lib.rc_tps_info(
+            self._handle, eid.encode(), model.encode(), kind.encode(),
+            ctypes.byref(ema), ctypes.byref(samples), ctypes.byref(last),
+        )
+        if not got:
+            return None
+        return float(ema.value), int(samples.value), float(last.value)
 
     def clear_endpoint(self, eid: str) -> None:
         self._lib.rc_clear_endpoint(self._handle, eid.encode())

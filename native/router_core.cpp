@@ -54,6 +54,11 @@ std::string key3(const char* eid, const char* model, const char* kind) {
 
 }  // namespace
 
+// Implemented in hash_chain.cpp (same shared object): hex SHA-256 of a byte
+// buffer. Reused for the rendezvous-hash owner below so the native and
+// Python (hashlib) sides agree bit for bit.
+extern "C" void sha256_hex(const char* data, int64_t len, char* out_hex);
+
 extern "C" {
 
 void* rc_new(double alpha) { return new RouterCore(alpha); }
@@ -198,6 +203,69 @@ int64_t rc_select(void* h, const char* model, const char** eids,
     rc->total_requests += 1;
   }
   return chosen;
+}
+
+// (ema, samples, last_update) for one tracked key. Returns 1 when the key is
+// measured, 0 otherwise. Feeds TPS gossip: the publisher ships the exact
+// local state and the receiver compares last_update for last-writer-wins.
+int32_t rc_tps_info(void* h, const char* eid, const char* model,
+                    const char* kind, double* ema, int64_t* samples,
+                    double* last_update) {
+  auto* rc = static_cast<RouterCore*>(h);
+  std::lock_guard<std::mutex> g(rc->mu);
+  auto it = rc->tps.find(key3(eid, model, kind));
+  if (it == rc->tps.end() || it->second.samples == 0) return 0;
+  *ema = it->second.ema;
+  *samples = it->second.samples;
+  *last_update = it->second.last_update;
+  return 1;
+}
+
+// Rendezvous (highest-random-weight) consistent-hash owner of `key` over n
+// candidate ids: argmax over the first 8 bytes (big-endian) of
+// sha256("key|id"), ties toward the lexicographically smallest id — the
+// exact rule of balancer.hrw_owner, so every worker (and both languages)
+// maps a prefix to the same endpoint with zero coordination. Returns the
+// winning index, or -1 for an empty candidate list.
+int64_t hrw_select(const char* key, const char** ids, int64_t n) {
+  if (n <= 0) return -1;
+  int64_t best = -1;
+  uint64_t best_w = 0;
+  std::string buf;
+  char hex[65];
+  for (int64_t i = 0; i < n; ++i) {
+    buf.assign(key);
+    buf.push_back('|');
+    buf += ids[i];
+    sha256_hex(buf.data(), static_cast<int64_t>(buf.size()), hex);
+    uint64_t w = 0;
+    for (int j = 0; j < 16; ++j) {
+      const char c = hex[j];
+      w = (w << 4) |
+          static_cast<uint64_t>(c <= '9' ? c - '0' : c - 'a' + 10);
+    }
+    if (best < 0 || w > best_w ||
+        (w == best_w && std::strcmp(ids[i], ids[best]) < 0)) {
+      best = i;
+      best_w = w;
+    }
+  }
+  return best;
+}
+
+// Constant-time byte comparison for the auth hot path (API keys, JWT
+// signatures): scans both buffers fully regardless of where they differ,
+// so the comparison leaks length only (Python twin: hmac.compare_digest).
+int32_t ct_equal(const uint8_t* a, int64_t alen, const uint8_t* b,
+                 int64_t blen) {
+  uint64_t acc = static_cast<uint64_t>(alen ^ blen);
+  const int64_t n = alen < blen ? alen : blen;
+  for (int64_t i = 0; i < n; ++i) acc |= static_cast<uint64_t>(a[i] ^ b[i]);
+  // fold in trailing bytes of the longer buffer so timing does not depend
+  // on the shorter prefix matching
+  for (int64_t i = n; i < alen; ++i) acc |= static_cast<uint64_t>(a[i]) | 1;
+  for (int64_t i = n; i < blen; ++i) acc |= static_cast<uint64_t>(b[i]) | 1;
+  return acc == 0 ? 1 : 0;
 }
 
 // Snapshot of the TPS map as tab/newline-separated text:

@@ -16,6 +16,23 @@ The gateway's own /metrics is scraped before and after the timed window and
 the TTFT/E2E/queue-wait percentile deltas are printed under "prometheus", so
 bench output and the Prometheus view agree on one source of truth.
 
+Multi-worker modes (docs/deployment.md):
+
+    python scripts/bench_gateway.py --workload throughput [--workers 4]
+
+spawns REAL gateway processes (`serve --workers N`, SO_REUSEPORT) in front
+of stub-engine processes and drives closed-loop load from separate client
+processes, recording the 1..N scaling curve with p50/p99 at matched load
+AND per-request gateway CPU from /proc (the core-count-independent figure
+— see the docstring on run_throughput_bench for why wall-clock scaling on
+a 2-core CI box measures the container, not the gateway).
+
+    python scripts/bench_gateway.py --workload chaos --workers 4
+
+runs the chaos drill across N shared-nothing worker states wired by the
+real gossip bus: >=99% client success while an endpoint flaps, plus the
+directly measured cross-worker breaker-propagation latency.
+
 A second mode measures the prefix KV cache end to end with a REAL in-process
 tpu:// engine (CPU backend) behind the gateway:
 
@@ -33,6 +50,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import re
 import sys
 import time
@@ -882,6 +900,614 @@ async def run_spec_bench(requests: int) -> dict:
     }
 
 
+def _run_stub_server(port: int) -> None:
+    """Hidden mode: a minimal OpenAI-compatible stub engine in its own
+    process, so gateway workers under test never share a Python runtime
+    (or GIL) with their upstream."""
+    from aiohttp import web
+
+    async def models(request):
+        return web.json_response(
+            {"object": "list",
+             "data": [{"id": "bench-model", "object": "model"}]}
+        )
+
+    payload = {
+        "id": "chatcmpl-stub", "object": "chat.completion",
+        "model": "bench-model",
+        "choices": [{"index": 0,
+                     "message": {"role": "assistant", "content": "pong"},
+                     "finish_reason": "stop"}],
+        "usage": {"prompt_tokens": 7, "completion_tokens": 2,
+                  "total_tokens": 9},
+    }
+    body = json.dumps(payload).encode()
+
+    async def chat(request):
+        await request.read()
+        return web.Response(body=body, content_type="application/json")
+
+    app = web.Application()
+    app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/chat/completions", chat)
+    web.run_app(app, host="127.0.0.1", port=port, access_log=None,
+                print=None)
+
+
+def _run_client_runner(spec_json: str) -> None:
+    """Hidden mode: one closed-loop load-generator process. Reads a JSON
+    spec {url, api_key, seconds, concurrency}, hammers
+    /v1/chat/completions, prints one JSON line {requests, errors,
+    latencies_sample} (reservoir-sampled so the pipe stays bounded)."""
+    import random
+
+    import aiohttp
+
+    spec = json.loads(spec_json)
+
+    async def run() -> dict:
+        rng = random.Random(1234)
+        payload = {
+            "model": "bench-model",
+            "messages": [{"role": "user", "content": "ping"}],
+            "stream": False,
+        }
+        headers = {"Authorization": f"Bearer {spec['api_key']}"}
+        done = 0
+        errors = 0
+        sample: list[float] = []  # reservoir, cap 4000
+        seen = 0
+        deadline = time.perf_counter() + spec["seconds"]
+        connector = aiohttp.TCPConnector(limit=0)
+        async with aiohttp.ClientSession(connector=connector) as session:
+
+            async def worker() -> None:
+                nonlocal done, errors, seen
+                while time.perf_counter() < deadline:
+                    t0 = time.perf_counter()
+                    try:
+                        async with session.post(
+                            spec["url"] + "/v1/chat/completions",
+                            json=payload, headers=headers,
+                        ) as resp:
+                            await resp.read()
+                            if resp.status == 200:
+                                done += 1
+                                lat = time.perf_counter() - t0
+                                seen += 1
+                                if len(sample) < 4000:
+                                    sample.append(lat)
+                                else:
+                                    j = rng.randrange(seen)
+                                    if j < 4000:
+                                        sample[j] = lat
+                            else:
+                                errors += 1
+                    except Exception:
+                        errors += 1
+
+            await asyncio.gather(
+                *(worker() for _ in range(spec["concurrency"]))
+            )
+        return {"requests": done, "errors": errors,
+                "latencies_sample": sample}
+
+    print(json.dumps(asyncio.run(run())))
+
+
+def _http_json(method: str, url: str, body=None, headers=None,
+               timeout: float = 5.0):
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        return resp.status, (json.loads(raw) if raw else None)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _gateway_worker_pids(supervisor_pid: int) -> list[int]:
+    """Direct children of the supervisor process (the forked workers)."""
+    pids: list[int] = []
+    try:
+        for task in os.listdir(f"/proc/{supervisor_pid}/task"):
+            path = f"/proc/{supervisor_pid}/task/{task}/children"
+            try:
+                with open(path) as f:
+                    pids.extend(int(p) for p in f.read().split())
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return sorted(set(pids))
+
+
+def _cpu_seconds(pids: list[int]) -> float:
+    """Total utime+stime of the given pids, in seconds."""
+    ticks = 0
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                fields = f.read().rsplit(")", 1)[1].split()
+            ticks += int(fields[11]) + int(fields[12])  # utime, stime
+        except (OSError, IndexError, ValueError):
+            pass
+    return ticks / os.sysconf("SC_CLK_TCK")
+
+
+def run_throughput_bench(seconds: float, concurrency: int,
+                         workers_list: list[int], clients: int) -> dict:
+    """Closed-loop wrk-style load against REAL gateway processes
+    (`serve --workers N`, SO_REUSEPORT) in front of stub engines, 1 vs N
+    workers on the same host. Load generators and stubs are separate
+    processes so neither shares a GIL with the gateway under test. Records
+    the scaling curve with p50/p99 at matched offered load (same client
+    pool for every N).
+
+    Honesty: on a host with fewer cores than (workers + clients + stubs)
+    the wall-clock curve measures the CONTAINER, not the gateway — Python
+    workers scale with physical cores, and a 2-core CI box cannot show 4x
+    anything. The bench therefore also records gateway CPU-time per
+    request from /proc (core-count independent): flat CPU/request from 1
+    to N workers means the multi-worker machinery (gossip, WAL sharing,
+    SO_REUSEPORT) adds no per-request cost, i.e. near-linear scaling
+    wherever cores exist. ``passed_3x_bar`` is only judged when the host
+    has enough cores to make the wall-clock claim meaningful."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="llmlb-throughput-")
+    procs: list = []
+    results: dict[str, dict] = {}
+    try:
+        stub_ports = [_free_port(), _free_port()]
+        for port in stub_ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, __file__, "--stub-server", str(port)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+        for port in stub_ports:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    status, _ = _http_json(
+                        "GET", f"http://127.0.0.1:{port}/v1/models"
+                    )
+                    if status == 200:
+                        break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                raise RuntimeError(f"stub on :{port} never came up")
+
+        for n in workers_list:
+            gw_port = _free_port()
+            data_dir = os.path.join(tmp, f"gw{n}")
+            env = dict(os.environ)
+            env.update({
+                "LLMLB_DATA_DIR": data_dir,
+                "LLMLB_LOG_DIR": os.path.join(data_dir, "logs"),
+                "LLMLB_ADMIN_PASSWORD": "benchpass1",
+                # hot-path knobs the deployment docs recommend for load:
+                # cached API-key auth, no per-request access log line
+                "LLMLB_AUTH_CACHE_TTL": "60",
+                "LLMLB_MAX_ACTIVE_PER_ENDPOINT": "4096",
+                "LLMLB_HEALTH_CHECK_INTERVAL": "1",
+                "LLMLB_TRACE_TIMELINE_SAMPLE": "0",
+                # batched history writes for EVERY point on the curve (it is
+                # the multi-worker default; the 1-worker baseline must not
+                # pay sync WAL commits the N-worker runs skip)
+                "LLMLB_HISTORY_FLUSH_SECS": "0.5",
+            })
+            base = f"http://127.0.0.1:{gw_port}"
+            gw_log_path = os.path.join(tmp, f"gw{n}.log")
+            gw_log = open(gw_log_path, "wb")
+            gw = subprocess.Popen(
+                [sys.executable, "-m", "llmlb_tpu.gateway.server", "serve",
+                 "--host", "127.0.0.1", "--port", str(gw_port),
+                 "--workers", str(n)],
+                env=env, stdout=subprocess.DEVNULL, stderr=gw_log,
+            )
+            procs.append(gw)
+            try:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if gw.poll() is not None:
+                        gw_log.flush()
+                        with open(gw_log_path, errors="replace") as f:
+                            tail = f.read()[-2000:]
+                        raise RuntimeError(
+                            f"gateway --workers {n} exited {gw.returncode}:"
+                            f"\n{tail}"
+                        )
+                    try:
+                        status, _ = _http_json("GET", f"{base}/health",
+                                               timeout=1)
+                        if status == 200:
+                            break
+                    except OSError:
+                        time.sleep(0.2)
+                else:
+                    raise RuntimeError("gateway never answered /health")
+
+                _, login = _http_json("POST", f"{base}/api/auth/login", {
+                    "username": "admin", "password": "benchpass1",
+                })
+                admin = {"Authorization": f"Bearer {login['token']}"}
+                _, key = _http_json("POST", f"{base}/api/api-keys", {
+                    "name": "bench",
+                    "permissions": ["openai.inference"],
+                }, headers=admin)
+                api_key = key["api_key"]
+                for port in stub_ports:
+                    _http_json("POST", f"{base}/api/endpoints", {
+                        "base_url": f"http://127.0.0.1:{port}",
+                        "name": f"stub-{port}",
+                        "endpoint_type": "openai_compatible",
+                    }, headers=admin)
+                # model appears once the (primary worker's) health checker
+                # probes + syncs; the registry change gossips to siblings
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        status, _ = _http_json(
+                            "POST", f"{base}/v1/chat/completions",
+                            {"model": "bench-model",
+                             "messages": [{"role": "user",
+                                           "content": "warm"}]},
+                            headers={"Authorization": f"Bearer {api_key}"},
+                        )
+                        if status == 200:
+                            break
+                    except OSError:
+                        pass
+                    time.sleep(0.3)
+                else:
+                    raise RuntimeError("bench-model never became routable")
+
+                worker_pids = _gateway_worker_pids(gw.pid) or [gw.pid]
+                cpu_before = _cpu_seconds(worker_pids)
+                spec = {"url": base, "api_key": api_key, "seconds": seconds,
+                        "concurrency": max(1, concurrency // clients)}
+                t0 = time.perf_counter()
+                runners = [subprocess.Popen(
+                    [sys.executable, __file__, "--client-runner",
+                     json.dumps(spec)],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                ) for _ in range(clients)]
+                rows = []
+                for r in runners:
+                    out, _ = r.communicate(timeout=seconds + 60)
+                    rows.append(json.loads(out))
+                elapsed = time.perf_counter() - t0
+                gw_cpu_s = _cpu_seconds(worker_pids) - cpu_before
+
+                requests_total = sum(r["requests"] for r in rows)
+                errors = sum(r["errors"] for r in rows)
+                lats = sorted(
+                    x for r in rows for x in r["latencies_sample"]
+                )
+
+                def pct(p: float) -> float | None:
+                    if not lats:
+                        return None
+                    return lats[min(len(lats) - 1, int(len(lats) * p))]
+
+                # per-worker spread from the merged, worker-labeled /metrics
+                per_worker: dict[str, float] = {}
+                try:
+                    import re as _re
+                    import urllib.request as _ur
+
+                    with _ur.urlopen(f"{base}/metrics", timeout=3) as resp:
+                        for line in resp.read().decode().splitlines():
+                            m = _re.match(
+                                r'llmlb_gateway_requests_total\{.*'
+                                r'route="/v1/chat/completions".*\} (\S+)',
+                                line,
+                            )
+                            if m:
+                                w = _re.search(r'worker="(\d+)"', line)
+                                wk = w.group(1) if w else "0"
+                                per_worker[wk] = (
+                                    per_worker.get(wk, 0.0) + float(m.group(1))
+                                )
+                except OSError:
+                    pass
+
+                results[str(n)] = {
+                    "workers": n,
+                    "req_per_sec": round(requests_total / elapsed, 1),
+                    "requests": requests_total,
+                    "errors": errors,
+                    "seconds": round(elapsed, 2),
+                    "concurrency": clients * spec["concurrency"],
+                    "client_processes": clients,
+                    "p50_ms": (round(pct(0.50) * 1000, 2)
+                               if lats else None),
+                    "p90_ms": (round(pct(0.90) * 1000, 2)
+                               if lats else None),
+                    "p99_ms": (round(pct(0.99) * 1000, 2)
+                               if lats else None),
+                    "per_worker_requests": per_worker,
+                    "gateway_cpu_seconds": round(gw_cpu_s, 2),
+                    "gateway_cpu_ms_per_request": (
+                        round(gw_cpu_s * 1000 / requests_total, 3)
+                        if requests_total else None
+                    ),
+                    # capacity one dedicated core would sustain at this
+                    # worker count's per-request cost — the figure that
+                    # transfers to a host with enough cores
+                    "implied_req_per_sec_per_gateway_core": (
+                        round(1000.0 * requests_total / (gw_cpu_s * 1000), 1)
+                        if gw_cpu_s > 0 and requests_total else None
+                    ),
+                }
+                print(f"[bench] workers={n}: "
+                      f"{results[str(n)]['req_per_sec']} req/s "
+                      f"p50={results[str(n)]['p50_ms']}ms "
+                      f"p99={results[str(n)]['p99_ms']}ms "
+                      f"cpu/req={results[str(n)]['gateway_cpu_ms_per_request']}ms "
+                      f"spread={per_worker}", file=sys.stderr)
+            finally:
+                if gw.poll() is None:
+                    gw.send_signal(_signal.SIGTERM)
+                    try:
+                        gw.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        gw.kill()
+
+        base_rps = results[str(workers_list[0])]["req_per_sec"]
+        curve = {
+            k: round(v["req_per_sec"] / base_rps, 2)
+            for k, v in results.items()
+        }
+        host_cpus = os.cpu_count() or 1
+        # a meaningful N-worker wall-clock claim needs cores for N workers
+        # plus the load generators and stubs feeding them
+        cores_needed = max(workers_list) + 2
+        out = {
+            "metric": "gateway_multiworker_throughput",
+            "unit": "req/s",
+            "workload": "closed-loop non-streaming chat vs stub engines",
+            "host_cpus": host_cpus,
+            "scaling_vs_1_worker": curve,
+            "runs": results,
+        }
+        base_cpu = results[str(workers_list[0])].get(
+            "gateway_cpu_ms_per_request"
+        )
+        top_cpu = results[str(max(workers_list))].get(
+            "gateway_cpu_ms_per_request"
+        )
+        if base_cpu and top_cpu:
+            # core-count-independent scaling evidence: per-request gateway
+            # CPU must not grow with worker count (gossip/WAL overhead)
+            out["cpu_per_request_ratio_Nv1"] = round(top_cpu / base_cpu, 2)
+        if "4" in results and "1" in results:
+            out["speedup_4_vs_1"] = round(
+                results["4"]["req_per_sec"] / results["1"]["req_per_sec"], 2
+            )
+            if host_cpus >= cores_needed:
+                out["passed_3x_bar"] = out["speedup_4_vs_1"] >= 3.0
+            else:
+                out["passed_3x_bar"] = None
+                out["note"] = (
+                    f"host has {host_cpus} cores; the 4-worker wall-clock "
+                    f"bar needs >= {cores_needed} (workers + load "
+                    "generators + stubs). Wall-clock curve recorded as "
+                    "measured; cpu_per_request_ratio_Nv1 is the "
+                    "core-independent scaling evidence on this host."
+                )
+        return out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def run_chaos_multiworker(seconds: float, concurrency: int,
+                                n_workers: int) -> dict:
+    """Chaos drill across N shared-nothing worker states wired by the real
+    gossip bus: one of two stub endpoints flaps (connect-refused at every
+    worker's HTTP boundary, ~50% duty). Clients round-robin across the
+    workers; the resilience layer + cross-worker breaker replication must
+    hold >=99% client success, and the run measures the breaker
+    propagation latency directly (trip on worker 0, time until every
+    sibling denies)."""
+    import tempfile
+
+    from llmlb_tpu.gateway.app_state import build_app_state
+    from llmlb_tpu.gateway.config import ServerConfig
+    from llmlb_tpu.gateway.db import Database
+    from llmlb_tpu.gateway.faults import FaultInjector, FaultRule
+    from llmlb_tpu.gateway.resilience import BreakerState
+    from llmlb_tpu.gateway.worker import WorkerInfo
+    from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+    from aiohttp.test_utils import TestClient, TestServer
+    from llmlb_tpu.gateway.app import create_app
+
+    tmp = tempfile.mkdtemp(prefix="llmlb-chaos-mw-")
+    os.environ["LLMLB_GOSSIP_DIR"] = os.path.join(tmp, "bus")
+    # bench-tuned breaker/backoff knobs (same spirit as the single-worker
+    # chaos run): several trip/half-open/close cycles within the window
+    os.environ.update({
+        "LLMLB_BREAKER_FAILURE_THRESHOLD": "3",
+        "LLMLB_BREAKER_OPEN_SECS": "0.5",
+        "LLMLB_BREAKER_OPEN_MAX_SECS": "2.0",
+        "LLMLB_RETRY_BACKOFF_BASE": "0.005",
+        "LLMLB_RETRY_BACKOFF_CAP": "0.05",
+        "LLMLB_FAILOVER_QUEUE_TIMEOUT": "1.0",
+        "LLMLB_ADMIN_PASSWORD": "adminpass1",
+        "LLMLB_JWT_SECRET": "chaos-mw-secret",
+        "LLMLB_AUTH_CACHE_TTL": "60",  # the multi-worker hot-path default
+    })
+    db_path = os.path.join(tmp, "gw.db")
+    config = ServerConfig.from_env()
+    config = config.__class__(**{**config.__dict__,
+                                 "database_url": db_path})
+
+    states = []
+    harnesses: list[GatewayHarness] = []
+    stable = await MockOpenAIEndpoint(model="chaos-model").start()
+    flappy = await MockOpenAIEndpoint(model="chaos-model").start()
+    try:
+        for i in range(n_workers):
+            state = await build_app_state(
+                config, db=Database(db_path), start_background=False,
+                worker=WorkerInfo(index=i, count=n_workers),
+            )
+            state.faults = FaultInjector()
+            client = TestClient(TestServer(create_app(state)))
+            await client.start_server()
+            states.append(state)
+            harnesses.append(GatewayHarness(state, client))
+        gw0 = harnesses[0]
+        gw0.register_mock(stable.url, ["chaos-model"], name="stable")
+        ep_flappy = gw0.register_mock(flappy.url, ["chaos-model"],
+                                      name="flappy")
+        await asyncio.sleep(0.1)  # registry gossip -> sibling reloads
+        for s in states[1:]:
+            assert s.registry.get(ep_flappy.id) is not None, \
+                "registry replication failed"
+        headers = dict(await gw0.inference_headers())
+
+        # --- direct propagation measurement (pre-traffic, clean clocks)
+        threshold = states[0].resilience.config.breaker_failure_threshold
+        t0 = time.perf_counter()
+        for _ in range(threshold):
+            states[0].resilience.record_failure(ep_flappy.id, "bench_trip")
+        while any(s.resilience.allow(ep_flappy.id) for s in states[1:]):
+            if time.perf_counter() - t0 > 2.0:
+                break
+            await asyncio.sleep(0.001)
+        propagation_s = time.perf_counter() - t0
+        propagated = not any(
+            s.resilience.allow(ep_flappy.id) for s in states[1:]
+        )
+        for s in states:
+            s.resilience.reset(ep_flappy.id)
+
+        # --- chaos traffic across all workers
+        ok = 0
+        failed = 0
+        statuses: dict[int, int] = {}
+        deadline = time.perf_counter() + seconds
+        running = True
+
+        async def flapper() -> None:
+            while running:
+                rules = [s.faults.add_rule(FaultRule(
+                    kind="connect_refused", endpoint="flappy", every_n=1,
+                )) for s in states]
+                await asyncio.sleep(0.7)
+                for s, rule in zip(states, rules):
+                    s.faults.remove_rule(rule)
+                await asyncio.sleep(0.7)
+
+        async def worker_task(i: int) -> None:
+            nonlocal ok, failed
+            n = 0
+            client = harnesses[i % n_workers].client
+            while time.perf_counter() < deadline:
+                n += 1
+                stream = (i + n) % 4 == 0
+                payload = {
+                    "model": "chaos-model",
+                    "messages": [{"role": "user", "content": f"ping {n}"}],
+                    "stream": stream,
+                }
+                try:
+                    resp = await client.post(
+                        "/v1/chat/completions", json=payload,
+                        headers=headers,
+                    )
+                    body = await resp.read()
+                    statuses[resp.status] = statuses.get(resp.status, 0) + 1
+                    if resp.status == 200 and (
+                        not stream or b"event: error" not in body
+                    ):
+                        ok += 1
+                    else:
+                        failed += 1
+                except Exception:
+                    failed += 1
+
+        flap_task = asyncio.create_task(flapper())
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker_task(i) for i in range(concurrency)))
+        elapsed = time.perf_counter() - t0
+        running = False
+        flap_task.cancel()
+        try:
+            await flap_task
+        except asyncio.CancelledError:
+            pass
+
+        total = ok + failed
+        success_rate = ok / max(1, total)
+        trips = sum(
+            1 for s in states
+            if s.resilience.state_of(ep_flappy.id) != BreakerState.CLOSED
+        )
+        gossip_stats = [s.gossip.stats() for s in states
+                        if s.gossip is not None]
+        return {
+            "metric": "chaos_multiworker_client_success_rate",
+            "value": round(success_rate, 5),
+            "unit": "fraction",
+            "passed": success_rate >= 0.99 and propagated,
+            "workers": n_workers,
+            "requests": total,
+            "ok": ok,
+            "failed": failed,
+            "statuses": statuses,
+            "seconds": round(elapsed, 2),
+            "req_per_sec": round(total / elapsed, 1),
+            "breaker_propagation_ms": round(propagation_s * 1000, 2),
+            "breaker_propagated_to_all_workers": propagated,
+            "stub_requests": {"stable": len(stable.requests_seen),
+                              "flappy": len(flappy.requests_seen)},
+            "workers_with_tripped_breaker_at_end": trips,
+            "gossip": {
+                "sent_total": sum(g["sent_total"] for g in gossip_stats),
+                "received_total": sum(
+                    g["received_total"] for g in gossip_stats
+                ),
+                "mean_lag_ms": round(
+                    sum(g["lag_s"] or 0.0 for g in gossip_stats)
+                    / max(1, len(gossip_stats)) * 1000, 3
+                ),
+            },
+        }
+    finally:
+        await stable.stop()
+        await flappy.stop()
+        for h in harnesses:
+            await h.client.close()
+
+
 async def run_chaos_bench(seconds: float, concurrency: int) -> dict:
     """Chaos drill: the real gateway + two stub endpoints serving one model,
     with one endpoint flapping hard (connect-refused injected at the proxy's
@@ -1032,14 +1658,41 @@ def main() -> None:
     parser.add_argument(
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
-                 "structured", "spec-decode", "quantized"),
+                 "structured", "spec-decode", "quantized", "throughput"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
                         help="request count for --workload shared-prefix / "
                              "mixed-length / structured / spec-decode / "
                              "quantized")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="gateway worker processes: the top of the "
+                             "scaling curve for --workload throughput "
+                             "(default 4), or the in-process worker count "
+                             "for --workload chaos (default 1)")
+    parser.add_argument("--clients", type=int, default=4,
+                        help="load-generator processes for --workload "
+                             "throughput")
+    # hidden child-process entry modes for --workload throughput
+    parser.add_argument("--stub-server", type=int, default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--client-runner", type=str, default=None,
+                        help=argparse.SUPPRESS)
     args = parser.parse_args()
+    if args.stub_server:
+        _run_stub_server(args.stub_server)
+        return
+    if args.client_runner:
+        _run_client_runner(args.client_runner)
+        return
+    if args.workload == "throughput":
+        top = max(2, args.workers or 4)
+        workers_list = sorted({1, 2, top} if top > 2 else {1, top})
+        result = run_throughput_bench(
+            args.seconds, args.concurrency, workers_list, args.clients
+        )
+        print(json.dumps(result))
+        return
     if args.workload not in ("proxy", "chaos"):
         _pin_platform()  # engine workloads touch jax: decide platform first
     if args.workload == "shared-prefix":
@@ -1059,9 +1712,14 @@ def main() -> None:
                   file=sys.stderr)
         result = asyncio.run(run_quantized_bench(max(args.requests, 40)))
     elif args.workload == "chaos":
-        result = asyncio.run(
-            run_chaos_bench(args.seconds, min(args.concurrency, 16))
-        )
+        if args.workers and args.workers > 1:
+            result = asyncio.run(run_chaos_multiworker(
+                args.seconds, min(args.concurrency, 16), args.workers
+            ))
+        else:
+            result = asyncio.run(
+                run_chaos_bench(args.seconds, min(args.concurrency, 16))
+            )
         print(json.dumps(result))
         if not result["passed"]:
             sys.exit(1)

@@ -96,9 +96,13 @@ def gateway_metric_names() -> set[str]:
     g.record_structured_rejected()
     g.record_slo("m", 0.01, 0.01)  # SLO goodput family
     names = set(_TYPE_RE.findall(g.render()))
-    # scrape-time gauges/counters injected by the /metrics handler
-    app_src = (REPO / "llmlb_tpu" / "gateway" / "app.py").read_text()
-    names |= set(_GATEWAY_LITERAL_RE.findall(app_src))
+    # scrape-time gauges/counters injected by the /metrics handler — the
+    # exposition builder lives in app_state.gateway_exposition (shared by
+    # the handler and the multi-worker metrics spool), with app.py kept in
+    # the scan for anything still injected at the route
+    for module in ("app.py", "app_state.py"):
+        src = (REPO / "llmlb_tpu" / "gateway" / module).read_text()
+        names |= set(_GATEWAY_LITERAL_RE.findall(src))
     return names
 
 

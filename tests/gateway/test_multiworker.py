@@ -1,0 +1,467 @@
+"""Multi-worker gateway: gossip replication, consistent-hash affinity,
+SO_REUSEPORT serving.
+
+Most tests model N workers in one process: N AppStates, each built with its
+own SQLite connection to one shared WAL file and its own GossipBus socket in
+one shared directory — exactly the state a forked worker holds, minus the
+fork. The last test boots the real thing (``serve --workers 2``) and checks
+the shared port + worker-labeled /metrics end to end.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from llmlb_tpu.gateway.app_state import build_app_state
+from llmlb_tpu.gateway.balancer import LoadManager, hrw_owner
+from llmlb_tpu.gateway.config import ServerConfig
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.resilience import BreakerState
+from llmlb_tpu.gateway.types import Endpoint, EndpointStatus, TpsApiKind
+from llmlb_tpu.gateway.worker import WorkerInfo
+
+BREAKER_PROPAGATION_BUDGET_S = 0.25  # the acceptance bound
+
+
+def _endpoint(name: str) -> Endpoint:
+    return Endpoint(name=name, base_url=f"http://{name}:1234",
+                    status=EndpointStatus.ONLINE)
+
+
+async def _worker_states(tmp_path, monkeypatch, n: int, *, gossip=True,
+                         port=45711):
+    """N shared-nothing AppStates wired like forked workers: shared DB file,
+    shared gossip dir, separate connections/buses."""
+    monkeypatch.setenv("LLMLB_GOSSIP_DIR", str(tmp_path / "bus"))
+    monkeypatch.setenv("LLMLB_GOSSIP", "1" if gossip else "0")
+    db_path = str(tmp_path / "gw.db")
+    config = ServerConfig(port=port, database_url=db_path)
+    states = []
+    for i in range(n):
+        states.append(await build_app_state(
+            config, db=Database(db_path), start_background=False,
+            worker=WorkerInfo(index=i, count=n),
+        ))
+    return states
+
+
+async def _wait_for(predicate, timeout_s: float, interval_s: float = 0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval_s)
+    return predicate()
+
+
+# ------------------------------------------------------------------- breaker
+
+
+async def test_breaker_trip_propagates_across_workers(tmp_path, monkeypatch):
+    """A breaker tripped on one worker ejects the endpoint on its sibling
+    within the 250 ms acceptance budget (gossip, not the 30 s health
+    probe)."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2)
+    try:
+        ep = _endpoint("engine-a")
+        s0.registry.add(ep)
+        # registry mutation gossips; the sibling reloads from the shared DB
+        assert await _wait_for(lambda: s1.registry.get(ep.id) is not None, 2.0)
+
+        threshold = s0.resilience.config.breaker_failure_threshold
+        t0 = time.monotonic()
+        for _ in range(threshold):
+            s0.resilience.record_failure(ep.id, "connect_error")
+        assert s0.resilience.state_of(ep.id) == BreakerState.OPEN
+        assert not s0.resilience.allow(ep.id)
+
+        assert await _wait_for(
+            lambda: not s1.resilience.allow(ep.id),
+            BREAKER_PROPAGATION_BUDGET_S,
+        ), "breaker open did not propagate to the sibling worker in 250ms"
+        propagation_s = time.monotonic() - t0
+        assert s1.resilience.state_of(ep.id) == BreakerState.OPEN
+        assert propagation_s < BREAKER_PROPAGATION_BUDGET_S
+
+        # recovery propagates too: the tripping worker's probe success
+        # closes the breaker everywhere
+        s0.resilience.note_probe(ep.id, True)  # open -> half_open
+        s0.resilience.on_admit(ep.id)
+        s0.resilience.record_success(ep.id)  # half_open -> closed
+        assert await _wait_for(
+            lambda: s1.resilience.state_of(ep.id) == BreakerState.CLOSED, 1.0
+        ), "breaker close did not propagate"
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_gossip_disabled_workers_converge_independently(
+    tmp_path, monkeypatch
+):
+    """LLMLB_GOSSIP=0: no replication, but correctness holds — each worker
+    trips its own breaker from its own in-band failures."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, gossip=False)
+    try:
+        assert s0.gossip is None and s1.gossip is None
+        ep = _endpoint("engine-b")
+        s0.registry.add(ep)
+        s1.registry.reload()  # no gossip: manual reload stands in for boot
+
+        threshold = s0.resilience.config.breaker_failure_threshold
+        for _ in range(threshold):
+            s0.resilience.record_failure(ep.id, "connect_error")
+        assert not s0.resilience.allow(ep.id)
+        await asyncio.sleep(0.1)
+        # sibling unaffected (nothing replicated)...
+        assert s1.resilience.allow(ep.id)
+        # ...and converges the moment its own failures arrive
+        for _ in range(threshold):
+            s1.resilience.record_failure(ep.id, "connect_error")
+        assert not s1.resilience.allow(ep.id)
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# ----------------------------------------------------------- tps + affinity
+
+
+async def test_tps_ema_gossips_between_workers(tmp_path, monkeypatch):
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2)
+    try:
+        ep = _endpoint("engine-c")
+        s0.registry.add(ep)
+        s0.load_manager.update_tps(ep.id, "m", TpsApiKind.CHAT, 120, 1.0)
+        assert await _wait_for(
+            lambda: s1.load_manager.get_tps(ep.id, "m", TpsApiKind.CHAT)
+            is not None, 1.0,
+        ), "TPS EMA did not replicate"
+        got = s1.load_manager.get_tps(ep.id, "m", TpsApiKind.CHAT)
+        assert got == pytest.approx(120.0)
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_retry_budget_spend_gossips(tmp_path, monkeypatch):
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2)
+    try:
+        before = s1.resilience.budget.snapshot()["retries_in_window"]
+        assert s0.resilience.budget.try_spend()
+        assert await _wait_for(
+            lambda: s1.resilience.budget.snapshot()["retries_in_window"]
+            == before + 1, 1.0,
+        ), "retry spend did not replicate into the sibling's window"
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_ring_affinity_agrees_across_workers(tmp_path, monkeypatch):
+    """Consistent-hash mode (the multi-worker default): every worker maps
+    the same prompt head to the same endpoint with zero coordination."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2)
+    try:
+        assert s0.load_manager.affinity_mode == "ring"
+        assert s1.load_manager.affinity_mode == "ring"
+        endpoints = [_endpoint(f"engine-{i}") for i in range(4)]
+        for h in (f"prefixhash-{k}" for k in range(32)):
+            picks = set()
+            for lm in (s0.load_manager, s1.load_manager):
+                got = lm.select_endpoint(endpoints, "m", prefix_hash=h)
+                picks.add(got.id)
+            assert len(picks) == 1, f"workers disagreed on prefix {h}"
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+def test_ring_remap_fraction_on_endpoint_removal():
+    """Removing one of E endpoints remaps only the keys it owned (~1/E);
+    every other key keeps its endpoint exactly — the consistent-hash
+    property that keeps (E-1)/E of engine prefix caches warm through
+    churn."""
+    ids = [f"ep-{i}" for i in range(5)]
+    keys = [f"prompthash-{k}" for k in range(1000)]
+    before = {k: hrw_owner(k, ids) for k in keys}
+    removed = "ep-2"
+    survivors = [e for e in ids if e != removed]
+    after = {k: hrw_owner(k, survivors) for k in keys}
+    remapped = [k for k in keys if before[k] != after[k]]
+    # only keys the removed endpoint owned may move...
+    assert all(before[k] == removed for k in remapped)
+    # ...and all of its keys must move (it is gone)
+    owned = [k for k in keys if before[k] == removed]
+    assert set(remapped) == set(owned)
+    frac = len(remapped) / len(keys)
+    assert 0.10 < frac < 0.32, f"remap fraction {frac} not ~1/5"
+
+
+def test_ring_mode_single_manager_sticks_and_counts():
+    """Ring mode through the LoadManager selection paths: deterministic
+    stickiness, hit/miss accounting, at-cap fallback."""
+    lm = LoadManager(use_native=False, affinity_mode="ring")
+    endpoints = [_endpoint(f"e{i}") for i in range(3)]
+    h = "deadbeef" * 5
+    first = lm.select_endpoint(endpoints, "m", prefix_hash=h)
+    for _ in range(5):
+        assert lm.select_endpoint(endpoints, "m", prefix_hash=h) is first
+    stats = lm.affinity_stats()
+    assert stats["hits_total"] == 6
+    assert stats["misses_total"] == 0
+    assert stats["entries"] == 0  # ring mode stores nothing
+
+    # owner saturated at cap: falls back to scoring, counts a miss
+    from llmlb_tpu.gateway.config import QueueConfig
+
+    lm2 = LoadManager(QueueConfig(max_active_per_endpoint=1),
+                      use_native=False, affinity_mode="ring")
+    got = lm2.try_admit(endpoints, "m", TpsApiKind.CHAT, prefix_hash=h)
+    assert got is not None and got[0] is first
+    got2 = lm2.try_admit(endpoints, "m", TpsApiKind.CHAT, prefix_hash=h)
+    assert got2 is not None and got2[0] is not first
+    assert lm2.affinity_stats()["misses_total"] == 1
+    got[1].fail()
+    got2[1].fail()
+    # capacity freed: the key snaps back to its owner
+    got3 = lm2.try_admit(endpoints, "m", TpsApiKind.CHAT, prefix_hash=h)
+    assert got3 is not None and got3[0] is first
+    got3[1].fail()
+
+
+def test_ring_native_python_parity():
+    try:
+        from llmlb_tpu.native import native_hrw_available, native_hrw_select
+    except ImportError:
+        pytest.skip("native module unavailable")
+    if not native_hrw_available():
+        pytest.skip("native hrw_select unavailable (run `make -C native`)")
+    ids = [f"endpoint-{i}" for i in range(7)]
+    for k in range(300):
+        key = f"prefix-{k:04d}"
+        assert ids[native_hrw_select(key, ids)] == hrw_owner(key, ids)
+
+
+async def test_lru_affinity_pin_gossips(tmp_path, monkeypatch):
+    """LLMLB_AFFINITY=lru with multiple workers: learned pins replicate so
+    siblings steer the same prefix without re-learning."""
+    monkeypatch.setenv("LLMLB_AFFINITY", "lru")
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45713)
+    try:
+        assert s0.load_manager.affinity_mode == "lru"
+        endpoints = [_endpoint(f"engine-{i}") for i in range(3)]
+        h = "feedface" * 5
+        pinned = s0.load_manager.select_endpoint(endpoints, "m",
+                                                 prefix_hash=h)
+        assert await _wait_for(
+            lambda: s1.load_manager._affinity_endpoint("m", h) == pinned.id,
+            1.0,
+        ), "lru affinity pin did not replicate"
+        assert s1.load_manager.select_endpoint(
+            endpoints, "m", prefix_hash=h
+        ) is pinned
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# ----------------------------------------------------------- registry + db
+
+
+async def test_admin_mutation_reaches_sibling_registry(tmp_path, monkeypatch):
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45714)
+    try:
+        ep = _endpoint("late-endpoint")
+        s0.registry.add(ep)
+        assert await _wait_for(
+            lambda: s1.registry.get(ep.id) is not None, 2.0
+        ), "endpoint added on one worker never appeared on the sibling"
+        s0.registry.remove(ep.id)
+        assert await _wait_for(
+            lambda: s1.registry.get(ep.id) is None, 2.0
+        ), "endpoint removal never propagated"
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_audit_chain_survives_concurrent_worker_flushes(
+    tmp_path, monkeypatch
+):
+    """Two workers flushing audit batches into one WAL file must keep the
+    hash chain linear (the atomic BEGIN IMMEDIATE flush)."""
+    from llmlb_tpu.gateway.audit import AuditEntry
+
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45715)
+    try:
+        for i in range(30):
+            s = (s0, s1)[i % 2]
+            s.audit.record(AuditEntry(
+                ts=time.time(), method="GET", path=f"/x/{i}", status=200,
+                duration_ms=1.0,
+            ))
+            if i % 5 == 4:
+                s0.audit.flush()
+                s1.audit.flush()
+        s0.audit.flush()
+        s1.audit.flush()
+        ok, err = s0.audit.verify()
+        assert ok, f"audit chain broken across workers: {err}"
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# -------------------------------------------------- satellites: knobs + logs
+
+
+def test_uvloop_knob_graceful_fallback(monkeypatch):
+    """LLMLB_UVLOOP=1 without uvloop installed must log-and-continue, not
+    crash the server; =0 must not touch the loop policy at all."""
+    from llmlb_tpu.gateway.server import maybe_install_uvloop
+
+    monkeypatch.setenv("LLMLB_UVLOOP", "0")
+    assert maybe_install_uvloop() is False
+    monkeypatch.setenv("LLMLB_UVLOOP", "1")
+    try:
+        import uvloop  # noqa: F401
+
+        has_uvloop = True
+    except ImportError:
+        has_uvloop = False
+    policy_before = asyncio.get_event_loop_policy()
+    try:
+        assert maybe_install_uvloop() is has_uvloop
+    finally:
+        asyncio.set_event_loop_policy(policy_before)
+
+
+def test_log_format_carries_worker_id(monkeypatch, tmp_path):
+    import logging
+
+    from llmlb_tpu.gateway.logging_setup import (
+        DEFAULT_LOG_FORMAT,
+        init_logging,
+    )
+
+    assert "%(worker)s" in DEFAULT_LOG_FORMAT  # the documented default
+    monkeypatch.setenv("LLMLB_WORKER_INDEX", "3")
+    monkeypatch.delenv("LLMLB_LOG_FORMAT", raising=False)
+    init_logging(str(tmp_path), file_sink=False)
+    try:
+        record = logging.getLogger("llmlb_tpu.test").makeRecord(
+            "llmlb_tpu.test", logging.INFO, __file__, 1, "hello", (), None
+        )
+        line = logging.Formatter(DEFAULT_LOG_FORMAT).format(record)
+        assert " w3 " in line
+        # custom format override wins
+        monkeypatch.setenv("LLMLB_LOG_FORMAT", "%(levelname)s|%(message)s")
+        init_logging(str(tmp_path), file_sink=False)
+        handler = next(h for h in logging.getLogger().handlers
+                       if getattr(h, "_llmlb_sink", False))
+        assert handler.formatter._fmt == "%(levelname)s|%(message)s"
+    finally:
+        monkeypatch.delenv("LLMLB_LOG_FORMAT", raising=False)
+        init_logging(str(tmp_path), file_sink=False)
+
+
+def test_label_exposition_injects_worker_label():
+    from llmlb_tpu.gateway.metrics import label_exposition
+
+    text = (
+        "# TYPE llmlb_gateway_requests_total counter\n"
+        'llmlb_gateway_requests_total{route="/v1/x",status="200"} 5\n'
+        "llmlb_gateway_active_requests 2\n"
+    )
+    out = label_exposition(text, "worker", "3")
+    assert ('llmlb_gateway_requests_total{route="/v1/x",status="200",'
+            'worker="3"} 5') in out
+    assert 'llmlb_gateway_active_requests{worker="3"} 2' in out
+    assert out.splitlines()[0].startswith("# TYPE")  # comments untouched
+
+
+# ------------------------------------------------------------ real processes
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_so_reuseport_two_workers_serve_one_port(tmp_path):
+    """The real thing: `serve --workers 2` forks two processes onto one
+    port; /health answers, /metrics carries worker labels and merges the
+    sibling's spooled series."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("SO_REUSEPORT unavailable on this platform")
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "LLMLB_DATA_DIR": str(tmp_path / "data"),
+        "LLMLB_LOG_DIR": str(tmp_path / "logs"),
+        "LLMLB_ADMIN_PASSWORD": "multiworker1",
+        "LLMLB_METRICS_SPOOL_SECS": "0.3",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "llmlb_tpu.gateway.server", "serve",
+         "--host", "127.0.0.1", "--port", str(port), "--workers", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 30
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                with urllib.request.urlopen(f"{base}/health", timeout=1) as r:
+                    if r.status == 200:
+                        up = True
+                        break
+            except OSError:
+                time.sleep(0.2)
+        assert up, (
+            f"gateway never came up: "
+            f"{proc.stderr.read().decode(errors='replace')[-2000:]}"
+            if proc.poll() is not None else "gateway never answered /health"
+        )
+        # give both workers time to write a metrics spool, then scrape a
+        # few times: whichever worker answers must include both workers
+        time.sleep(1.0)
+        saw_workers = set()
+        for _ in range(6):
+            with urllib.request.urlopen(f"{base}/metrics", timeout=2) as r:
+                text = r.read().decode()
+            for needle in ('worker="0"', 'worker="1"'):
+                if needle in text:
+                    saw_workers.add(needle)
+            if len(saw_workers) == 2:
+                break
+            time.sleep(0.5)
+        assert saw_workers == {'worker="0"', 'worker="1"'}, (
+            f"merged /metrics missing worker series: {saw_workers}"
+        )
+        with urllib.request.urlopen(f"{base}/api/health", timeout=2) as r:
+            body = json.loads(r.read().decode())
+        assert body["worker"]["count"] == 2
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)

@@ -13,8 +13,11 @@ from llmlb_tpu.native import (
     native_chain_hash,
 )
 
+from tests.conftest import native_skip_reason
+
 pytestmark = pytest.mark.skipif(
-    load_native() is None, reason="native toolchain unavailable"
+    load_native() is None,
+    reason=native_skip_reason() or "native library unavailable",
 )
 
 
@@ -94,6 +97,25 @@ def test_safetensors_reader_rejects_garbage(tmp_path):
         NativeSafetensors(str(tmp_path / "missing.safetensors"))
 
 
+def test_ct_equal_matches_compare_digest():
+    import hmac
+
+    from llmlb_tpu.native import native_ct_equal
+
+    cases = [
+        (b"", b""), (b"a", b"a"), (b"a", b"b"), (b"a", b""),
+        (b"sk_" + b"x" * 43, b"sk_" + b"x" * 43),
+        (b"sk_" + b"x" * 43, b"sk_" + b"x" * 42 + b"y"),
+        (b"\x00\x01\x02", b"\x00\x01\x02"),  # embedded NULs must compare
+        (b"\x00\x01\x02", b"\x00\x01\x03"),
+        (b"abc", b"abcd"), (b"abcd", b"abc"),
+    ]
+    for a, b in cases:
+        got = native_ct_equal(a, b)
+        assert got is not None
+        assert got == hmac.compare_digest(a, b), (a, b)
+
+
 def _sse_frames(payloads):
     return b"".join(
         b"data: " + json.dumps(p).encode() + b"\n\n" for p in payloads
@@ -119,6 +141,85 @@ def test_sse_scanner_matches_python_accumulator():
     for i in range(0, len(stream), 7):
         acc.feed(stream[i:i + 7])
     assert acc.finalize() == (11, 2, True)
+
+
+def test_sse_scanner_fuzz_parity_random_chunk_boundaries():
+    """Parity fuzz (guards the native fast path against drift): identical
+    byte streams, split at randomized chunk boundaries, through the native
+    scanner and the pure-Python splitter must report identical frame counts
+    and usage. Streams mix normal deltas, usage placement variants,
+    [DONE], comments, CRLF, partial junk, and multi-frame chunks."""
+    import random
+
+    from llmlb_tpu.gateway.token_accounting import StreamingTokenAccumulator
+
+    rng = random.Random(0xC0FFEE)
+
+    def random_stream() -> bytes:
+        frames = []
+        n = rng.randrange(1, 12)
+        for i in range(n):
+            roll = rng.random()
+            if roll < 0.5:
+                frames.append(
+                    {"choices": [{"delta": {"content": f"tok{i}" * rng.randrange(1, 4)}}]}
+                )
+            elif roll < 0.65:
+                frames.append({"choices": [],
+                               "usage": {"prompt_tokens": rng.randrange(0, 500),
+                                         "completion_tokens": rng.randrange(0, 500)}})
+            elif roll < 0.75:
+                frames.append({"type": "response.output_text.delta",
+                               "delta": "x" * rng.randrange(1, 30)})
+            elif roll < 0.85:
+                frames.append({"choices": [{"delta": {}}],
+                               "usage": {"input_tokens": rng.randrange(0, 99),
+                                         "output_tokens": rng.randrange(0, 99)}})
+            else:
+                frames.append({"choices": [{"delta": {"content": 'q"u\\o✓te'}}]})
+        raw = b""
+        for f in frames:
+            body = json.dumps(f).encode()
+            sep = rng.choice([b"\n\n", b"\r\n\r\n", b"\n"])
+            prefix = rng.choice([b"data: ", b"data:", b"data:  "])
+            raw += prefix + body + sep
+            if rng.random() < 0.2:
+                raw += rng.choice([b": keepalive\n", b"event: ping\n",
+                                   b"\n", b"data:\n"])
+        if rng.random() < 0.8:
+            raw += b"data: [DONE]\n\n"
+        return raw
+
+    for case in range(50):
+        stream = random_stream()
+        # random chunking: 1..23-byte slices, including empty-chunk no-ops
+        chunks = []
+        pos = 0
+        while pos < len(stream):
+            step = rng.randrange(1, 24)
+            chunks.append(stream[pos:pos + step])
+            pos += step
+
+        scanner = NativeSseScanner()
+        acc = StreamingTokenAccumulator()
+        acc._native = None  # force the pure-Python splitter
+        acc._raw = None
+        for c in chunks:
+            scanner.feed(c)
+            acc._feed_python(c)
+        assert scanner.frames == acc._chunks_seen, (
+            f"case {case}: frame count diverged "
+            f"(native {scanner.frames} vs python {acc._chunks_seen})\n"
+            f"stream={stream!r}"
+        )
+        native_usage = scanner.usage()
+        python_usage = acc._usage
+        if python_usage is not None and python_usage != (0, 0):
+            assert native_usage == python_usage, (
+                f"case {case}: usage diverged "
+                f"(native {native_usage} vs python {python_usage})\n"
+                f"stream={stream!r}"
+            )
 
 
 def test_sse_scanner_responses_api_usage_and_no_usage():
