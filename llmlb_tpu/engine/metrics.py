@@ -122,6 +122,12 @@ class EngineMetrics:
         self.spec_draft_tokens_total = 0
         self.spec_accepted_tokens_total = 0
         self.spec_emitted_tokens_total = 0
+        # Overload protection (docs/scheduling.md): slots parked under
+        # slot/page pressure, parked requests re-activated, and requests
+        # shed at admission because their deadline had already passed.
+        self.preemptions_total = 0
+        self.preempt_resumes_total = 0
+        self.deadline_shed_total = 0
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -241,6 +247,18 @@ class EngineMetrics:
             if slow:
                 self.slow_steps_total += 1
 
+    def record_preemption(self) -> None:
+        with self._lock:
+            self.preemptions_total += 1
+
+    def record_resume(self) -> None:
+        with self._lock:
+            self.preempt_resumes_total += 1
+
+    def record_deadline_shed(self) -> None:
+        with self._lock:
+            self.deadline_shed_total += 1
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -280,6 +298,9 @@ class EngineMetrics:
                           / self.spec_draft_tokens_total, 4)
                     if self.spec_draft_tokens_total else None
                 ),
+                "preemptions_total": self.preemptions_total,
+                "preempt_resumes_total": self.preempt_resumes_total,
+                "deadline_shed_total": self.deadline_shed_total,
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
@@ -287,7 +308,8 @@ class EngineMetrics:
                kv_cache: dict | None = None,
                structured: dict | None = None,
                perf: dict | None = None,
-               quant: dict | None = None) -> str:
+               quant: dict | None = None,
+               sched: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
@@ -364,7 +386,25 @@ class EngineMetrics:
                 "# TYPE llmlb_engine_spec_emitted_tokens_total counter",
                 "llmlb_engine_spec_emitted_tokens_total "
                 f"{self.spec_emitted_tokens_total}",
+                "# TYPE llmlb_engine_preemptions_total counter",
+                f"llmlb_engine_preemptions_total {self.preemptions_total}",
+                "# TYPE llmlb_engine_preempt_resumes_total counter",
+                "llmlb_engine_preempt_resumes_total "
+                f"{self.preempt_resumes_total}",
+                "# TYPE llmlb_engine_deadline_shed_total counter",
+                f"llmlb_engine_deadline_shed_total {self.deadline_shed_total}",
             ]
+            if sched is not None:
+                lines.append(
+                    "# TYPE llmlb_engine_queue_depth_class gauge"
+                )
+                for name, depth in sorted(
+                    (sched.get("queued_by_class") or {}).items()
+                ):
+                    lines.append(
+                        f'llmlb_engine_queue_depth_class'
+                        f'{{priority="{name}"}} {depth}'
+                    )
             if perf is not None and perf.get("available"):
                 lines += [
                     "# TYPE llmlb_engine_mfu_ratio gauge",

@@ -32,6 +32,7 @@ this is the in-tree `tpu://` engine of the BASELINE.json north star.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import math
@@ -60,6 +61,13 @@ from llmlb_tpu.spec import PromptLookupDrafter, SpecConfig
 from llmlb_tpu.structured.constraint import ConstraintState, TokenConstraint
 
 log = logging.getLogger("llmlb_tpu.engine")
+
+# Priority classes (docs/scheduling.md): lower value = more important.
+# Dialect-facing names map high/normal/low onto 0/1/2 at the HTTP layer.
+PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_LOW = 0, 1, 2
+PRIORITY_CLASSES = (PRIORITY_HIGH, PRIORITY_NORMAL, PRIORITY_LOW)
+PRIORITY_NAMES = {PRIORITY_HIGH: "high", PRIORITY_NORMAL: "normal",
+                  PRIORITY_LOW: "low"}
 
 
 def kv_cache_bytes(cfg, num_slots: int, slot_capacity: int) -> int:
@@ -204,6 +212,36 @@ class SamplingParams:
     # defaults, max_draft_tokens clamps into the engine's verify width.
     # JSON-safe, rides the plan wire like `constraint`.
     speculative: dict | None = None
+    # Priority class (docs/scheduling.md): 0=high, 1=normal, 2=low. The
+    # scheduler admits strictly by class (FIFO within a class) and may
+    # PREEMPT a lower-class decoding slot under slot/page pressure — the
+    # parked request resumes later, token-identical (greedy/seeded).
+    # Plain int so it rides the multihost plan wire as-is.
+    priority: int = 1
+    # Relative deadline in milliseconds from submission (None = none). A
+    # request still queued past its deadline is shed before it burns a
+    # prefill; the gateway propagates client deadlines via the
+    # X-Request-Deadline-Ms header.
+    deadline_ms: float | None = None
+
+
+@dataclasses.dataclass
+class ParkedState:
+    """Everything a preempted request needs to resume token-identical: the
+    tokens it already committed (prompt KV is rebuilt by a chunk-prefill of
+    prompt + these), its generation progress, and the host-side cursors that
+    must NOT re-walk from scratch — the grammar FSM cursor (a fresh
+    ConstraintState would mask as if at string start) and the prompt-lookup
+    drafter index (cheap to rebuild, but reusing it preserves behavior
+    exactly). Sampling determinism needs no state here: seeded rows fold
+    PRNGKey(seed) by absolute position, so the resumed chunk-prefill's
+    activation sample IS the next uninterrupted sample."""
+
+    generated: int
+    tokens: list[int]
+    constraint: ConstraintState | None = None
+    drafter: PromptLookupDrafter | None = None
+    spec_k: int = 0
 
 
 @dataclasses.dataclass
@@ -224,9 +262,22 @@ class Request:
     # direct core submitters get it compiled at insert via the core's
     # constraint_compiler. Never serialized — followers rebuild from the spec.
     compiled_constraint: TokenConstraint | None = None
+    # Preemption (docs/scheduling.md): set by _park_slot when this request is
+    # parked under slot/page pressure, consumed at re-activation. While set,
+    # insert paths prefill prompt_ids + parked.tokens and restore the
+    # generation cursor instead of starting over. Host-local — never crosses
+    # the plan wire (every host parks/resumes its own mirror identically).
+    parked: ParkedState | None = None
 
     def cancel(self) -> None:
         self.cancelled = True
+
+    def deadline_expired(self, now: float | None = None) -> bool:
+        dl = self.sampling.deadline_ms
+        if dl is None:
+            return False
+        return ((now if now is not None else time.monotonic())
+                > self.submitted_at + float(dl) / 1000.0)
 
 
 @dataclasses.dataclass
@@ -260,6 +311,10 @@ class _Slot:
     # speculate. spec_k is the request's draft budget per verify step.
     drafter: PromptLookupDrafter | None = None
     spec_k: int = 0
+    # Every token emitted so far, in order (EOS excluded — a finished
+    # request is never parked). Preemption needs the committed sequence to
+    # rebuild KV via chunk-prefill; bounded by max_tokens per slot.
+    out_tokens: list[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -297,6 +352,7 @@ class EngineCore:
         spec_max_draft: int | None = None,
         spec_ngram: int | None = None,
         quantize: str | None = None,
+        prefill_chunk_budget: int | None = None,
     ):
         self.cfg = cfg
         # Family module (llama / mixtral) supplying the serving fns — one
@@ -662,6 +718,43 @@ class EngineCore:
         # snapshots .queue to find cancelled-but-still-queued requests;
         # in that mode the loop thread is both producer and consumer.
         self.pending: queue.Queue[Request] = queue.Queue()
+        # Priority admission (docs/scheduling.md): the step loop drains
+        # `pending` (the thread-safe intake) into per-class deques and
+        # always serves the most important non-empty class, FIFO within a
+        # class. Preempted requests re-enter at the FRONT of their class —
+        # they already held a slot once. Step-loop-private state, so every
+        # multihost host mirrors it deterministically from the plan order.
+        self._class_queues: dict[int, collections.deque] = {
+            p: collections.deque() for p in PRIORITY_CLASSES
+        }
+        # Chunked-prefill decode budget: max prompt tokens prefilled per
+        # step-loop iteration WHILE other slots are decoding (0 = no cap).
+        # Bounds the decoders' ITL regardless of arriving prompt size: a
+        # long prompt runs as budget-sized chunks with decode steps between.
+        if prefill_chunk_budget is None:
+            try:
+                prefill_chunk_budget = int(os.environ.get(
+                    "LLMLB_PREFILL_CHUNK_BUDGET", "0") or 0)
+            except ValueError:
+                log.warning("LLMLB_PREFILL_CHUNK_BUDGET is not an integer; "
+                            "budget disabled")
+                prefill_chunk_budget = 0
+        self.prefill_chunk_budget = max(0, int(prefill_chunk_budget))
+        if (self.prefill_chunk_budget and self.prefill_buckets
+                and self.prefill_chunk_budget < self.prefill_buckets[0]):
+            # chunks must be compiled bucket sizes, so a budget below the
+            # smallest bucket cannot be honored exactly
+            log.warning(
+                "prefill chunk budget %d is below the smallest prefill "
+                "bucket; effective per-chunk floor is %d tokens",
+                self.prefill_chunk_budget, self.prefill_buckets[0],
+            )
+        # Prompt tokens already dispatched to prefill in the CURRENT step-loop
+        # iteration (_try_insert's one-shot batches). _advance_prefill only
+        # spends what remains, so an iteration that both inserted a batch and
+        # feeds a chunk stays bounded by the budget (+ at most one
+        # minimum-bucket rounding) instead of paying each path a full budget.
+        self._prefill_spent_iter = 0
         self.metrics = EngineMetrics()
         # Step introspection (engine/stepstats.py): per-step phase records,
         # slow-step anomalies, and the sliding decode window live MFU math
@@ -807,6 +900,7 @@ class EngineCore:
     def stats(self) -> EngineStats:
         active = sum(1 for s in self.slots if s.request is not None)
         queued = self.pending.qsize()
+        queued += sum(len(q) for q in self._class_queues.values())
         if self._held_request is not None:
             queued += 1  # parked on page-pool pressure, still queued work
         if self.coordinator is not None:
@@ -867,6 +961,14 @@ class EngineCore:
                 req.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
                 continue
+            if req.deadline_expired():
+                # deadline shedding must be deterministic across hosts, so
+                # multihost sheds HERE (leader-only, before the plan) — a
+                # shed request never reaches any host's queue
+                req.events.put(("error", "deadline exceeded before prefill"))
+                self.metrics.record_request_done("error")
+                self.metrics.record_deadline_shed()
+                continue
             n = len(req.prompt_ids)
             if n > budget:
                 req.events.put(("error", "prompt too large for a tick plan"))
@@ -888,6 +990,7 @@ class EngineCore:
         # snapshot atomic regardless of which thread produces into pending
         with self.pending.mutex:
             in_flight += list(self.pending.queue)
+        in_flight += self._queued_requests()  # drained into class deques
         for req in in_flight:
             if req.cancelled and req.request_id not in self._cancelled_effective:
                 cancelled.append(req.request_id)
@@ -1027,6 +1130,207 @@ class EngineCore:
             if s.request is None and i not in pinned
         ]
 
+    # ------------------------------------------ priority classes / preemption
+
+    @staticmethod
+    def _priority_of(request: Request) -> int:
+        try:
+            p = int(request.sampling.priority)
+        except (TypeError, ValueError):
+            p = PRIORITY_NORMAL
+        return min(PRIORITY_LOW, max(PRIORITY_HIGH, p))
+
+    def _effective_prompt(self, request: Request) -> list[int]:
+        """The token sequence an insert must land in KV: the prompt, plus —
+        for a preempted request resuming — every token it already emitted.
+        Chunk-prefilling the committed sequence puts each token's KV at the
+        exact position the uninterrupted run had it, and the activation
+        sample (step = len-1) draws the exact PRNG fold the next decode
+        token would have used, so the resumed stream is token-identical."""
+        if request.parked is not None:
+            return list(request.prompt_ids) + request.parked.tokens
+        return request.prompt_ids
+
+    def _drain_pending(self) -> None:
+        while True:
+            try:
+                r = self.pending.get_nowait()
+            except queue.Empty:
+                return
+            self._class_queues[self._priority_of(r)].append(r)
+
+    def _queued_requests(self) -> list[Request]:
+        out: list[Request] = []
+        for p in PRIORITY_CLASSES:
+            out.extend(self._class_queues[p])
+        return out
+
+    def _pop_request(self) -> Request | None:
+        """Next request to admit: strictly by class. The held (page-starved)
+        request keeps its place at the FRONT of its own class — but a
+        MORE-important class still pops first, else a low-priority request
+        wedged on the page pool would block the very arrival whose
+        page-pressure preemption could unwedge it (priority inversion)."""
+        held = self._held_request
+        held_prio = self._priority_of(held) if held is not None else None
+        for p in PRIORITY_CLASSES:
+            if held_prio is not None and p >= held_prio:
+                break
+            q = self._class_queues[p]
+            if q:
+                return q.popleft()
+        if held is not None:
+            self._held_request = None
+            return held
+        for p in PRIORITY_CLASSES:
+            q = self._class_queues[p]
+            if q:
+                return q.popleft()
+        return None
+
+    def _head_priority(self) -> int | None:
+        """Priority of the next request _pop_request would return."""
+        best: int | None = None
+        if self._held_request is not None:
+            best = self._priority_of(self._held_request)
+        for p in PRIORITY_CLASSES:
+            if self._class_queues[p]:
+                return p if best is None else min(best, p)
+        return best
+
+    def _hold_on_pool(self, request: Request) -> None:
+        """Queue a page-starved request for the next tick's retry. Only one
+        hold slot exists; a request popped PAST a still-held one (a
+        more-important class, see _pop_request) must not overwrite it —
+        the overwritten request's event queue would never answer."""
+        if self._held_request is None:
+            self._held_request = request
+        else:
+            self._class_queues[self._priority_of(request)].appendleft(request)
+
+    def _preempt_candidates(self, prio: int) -> list[int]:
+        """Decoding slots a class-`prio` request may park, least important
+        first, then least committed tokens (cheapest re-prefill), then slot
+        id — a deterministic order every multihost mirror computes
+        identically. Prefilling slots are never parked (their KV is
+        incomplete), and first_pending slots' last token is device-only, so
+        parking one would lose it."""
+        out = [
+            i for i, s in enumerate(self.slots)
+            if (s.request is not None and not s.prefilling
+                and not s.first_pending
+                and self._priority_of(s.request) > prio)
+        ]
+        out.sort(key=lambda i: (-self._priority_of(self.slots[i].request),
+                                int(self._seq_lens[i]), i))
+        return out
+
+    def _park_slot(self, slot_id: int) -> None:
+        """Preempt one decoding slot: release its KV (pages back to the pool
+        — parking is cheap BECAUSE the layout is paged), capture resume
+        state on the request, and requeue it at the front of its class. The
+        grammar cursor and drafter park WITH the request; a resume must
+        never re-walk the FSM from its start state."""
+        slot = self.slots[slot_id]
+        request = slot.request
+        assert request is not None and not slot.prefilling
+        request.parked = ParkedState(
+            generated=slot.generated,
+            tokens=list(slot.out_tokens),
+            constraint=slot.constraint,
+            drafter=slot.drafter,
+            spec_k=slot.spec_k,
+        )
+        self._release_cache_entry(slot)
+        self._free_slot_kv(slot_id)
+        if slot.constraint is not None:
+            # cursor parked above — tear down only the live mask row
+            self._constrained_count -= 1
+            if self._mask_bias is not None:
+                self._mask_bias[slot_id] = 0.0
+                self._mask_dirty_rows.add(slot_id)
+            slot.constraint = None
+        slot.request = None
+        slot.generated = 0
+        slot.last_emit_at = 0.0
+        slot.first_pending = False
+        slot.prefilling = False
+        slot.prefill_pos = 0
+        slot.out_tokens = []
+        slot.drafter = None
+        slot.spec_k = 0
+        self.metrics.record_preemption()
+        log.info("preempted request %s at %d committed tokens (priority %s)",
+                 request.request_id, len(request.parked.tokens),
+                 PRIORITY_NAMES[self._priority_of(request)])
+        self._class_queues[self._priority_of(request)].appendleft(request)
+
+    def _preempt_for_pages(self, prio: int) -> bool:
+        """Page pressure: park one less-important slot that actually holds
+        pages, so the reservation retry can succeed. False when no eligible
+        victim exists (the caller then holds the request as before)."""
+        for i in self._preempt_candidates(prio):
+            if self._slot_pages[i]:
+                self._park_slot(i)
+                return True
+        return False
+
+    def _shed_expired(self, request: Request) -> bool:
+        """Deadline shedding at admission (single-host only: clocks differ
+        across hosts, so multihost sheds at the leader's plan collection
+        instead). Never sheds a resumed request — the client already holds
+        part of its stream."""
+        if (self.coordinator is not None or request.parked is not None
+                or not request.deadline_expired()):
+            return False
+        request.events.put(("error", "deadline exceeded before prefill"))
+        self.metrics.record_request_done("error")
+        self.metrics.record_deadline_shed()
+        return True
+
+    def _prefill_budget_now(self) -> int:
+        """Prompt tokens this step-loop iteration may spend on prefill
+        (0 = uncapped). The cap applies only while some slot is decoding —
+        an idle engine prefills at full width."""
+        b = self.prefill_chunk_budget
+        if b <= 0:
+            return 0
+        if not any(s.request is not None and not s.prefilling
+                   for s in self.slots):
+            return 0
+        return b
+
+    def _budget_chunk_len(self, budget: int) -> int:
+        """Largest prefill bucket within the budget (floor: the smallest
+        bucket — chunks must be a compiled size)."""
+        best = self.prefill_buckets[0]
+        for bkt in self.prefill_buckets:
+            if bkt <= budget:
+                best = bkt
+        return best
+
+    def queue_class_depths(self) -> dict[str, int]:
+        """Queued requests per priority class (held request included) for
+        /metrics and the sched info block."""
+        depths = {PRIORITY_NAMES[p]: len(self._class_queues[p])
+                  for p in PRIORITY_CLASSES}
+        held = self._held_request
+        if held is not None:
+            depths[PRIORITY_NAMES[self._priority_of(held)]] += 1
+        return depths
+
+    def sched_info(self) -> dict:
+        """Scheduling block for /api/system, /api/health, and /metrics:
+        priority-queue depths plus the overload-protection counters."""
+        m = self.metrics
+        return {
+            "prefill_chunk_budget": self.prefill_chunk_budget,
+            "queued_by_class": self.queue_class_depths(),
+            "preemptions_total": m.preemptions_total,
+            "preempt_resumes_total": m.preempt_resumes_total,
+            "deadline_shed_total": m.deadline_shed_total,
+        }
+
     # -------------------------------------------------------------- page pool
 
     def _pages_for_tokens(self, n: int) -> int:
@@ -1102,13 +1406,32 @@ class EngineCore:
         kept = []
         for i in active:
             slot = self.slots[i]
+            if slot.request is None:
+                # parked by a page-pressure preemption earlier in this walk
+                continue
             kk = per_row.get(i, k) if per_row is not None else k
             target = min(int(self._seq_lens[i]) + kk + 1, self.slot_capacity)
             need = self._pages_for_tokens(target) - len(self._slot_pages[i])
             if need > 0:
                 fresh = self._try_reserve_pages(need)
+                # a more important row may park less important decoders
+                # before giving up (their pages come back to the pool)
+                while fresh is None and self._preempt_for_pages(
+                        self._priority_of(slot.request)):
+                    fresh = self._try_reserve_pages(need)
                 if fresh is None:
                     request = slot.request
+                    if not slot.first_pending and len(active) > 1:
+                        # Park rather than force-finish: the pre-preemption
+                        # engine cut the request off at 'length' here; now
+                        # it resumes token-identical once pages free up.
+                        log.warning(
+                            "page pool exhausted mid-decode; parking request "
+                            "%s at %d tokens", request.request_id,
+                            int(self._seq_lens[i]),
+                        )
+                        self._park_slot(i)
+                        continue
                     log.warning(
                         "page pool exhausted mid-decode; finishing request "
                         "%s at %d tokens", request.request_id,
@@ -1131,6 +1454,7 @@ class EngineCore:
                     slot.first_pending = False
                     slot.drafter = None
                     slot.spec_k = 0
+                    slot.out_tokens = []
                     continue
                 self._extend_slot_pages(i, fresh)
             kept.append(i)
@@ -1138,6 +1462,10 @@ class EngineCore:
 
     def _try_insert(self) -> bool:
         plan_start = time.perf_counter()
+        self._prefill_spent_iter = 0  # first call of every loop iteration
+        self._drain_pending()
+        queued = (sum(len(q) for q in self._class_queues.values())
+                  + (1 if self._held_request is not None else 0))
         free = self._free_slots()
         if (not free and self.page_pool is None
                 and self.prefix_cache is not None and len(self.prefix_cache)):
@@ -1146,33 +1474,61 @@ class EngineCore:
             # cache. Paged donors never pin slots, so evicting here could not
             # free one and would just drain the warm cache for nothing; paged
             # PAGE pressure has its own eviction path in _try_reserve_pages.
-            if self.pending.qsize() > 0 and self._evict_one_prefix():
+            if queued > 0 and self._evict_one_prefix():
                 free = self._free_slots()
+        if not free and queued > 0:
+            # Slot-pressure preemption: a queued request of a MORE important
+            # class than some decoding slot parks the least important victim
+            # (docs/scheduling.md). Same-class work always waits its turn.
+            head = self._head_priority()
+            if head is not None:
+                cands = self._preempt_candidates(head)
+                if cands:
+                    self._park_slot(cands[0])
+                    free = self._free_slots()
         if not free:
             return False
         max_oneshot = self.prefill_buckets[-1] if self.prefill_buckets else 0
+        # Chunked-prefill decode budget: while decoders are active, at most
+        # `budget` prompt tokens prefill this iteration — larger prompts run
+        # through the chunked path and one-shot batches stop accumulating at
+        # the budget, so decode steps interleave (bounded ITL).
+        budget = self._prefill_budget_now()
+        long_cutoff = max_oneshot
+        if budget:
+            long_cutoff = min(max_oneshot, self._budget_chunk_len(budget))
         handled = False
         inserted = 0  # long inserts count toward the group cap too
         batch: list[tuple[int, Request, int]] = []  # (slot_id, request, n)
+        batch_tokens = 0
         while free and len(batch) + inserted < self.MAX_PREFILL_GROUP:
-            if self._held_request is not None:
-                # a request the page pool could not cover last tick retries
-                # ahead of newer arrivals (preserves FIFO order)
-                request, self._held_request = self._held_request, None
-            else:
-                try:
-                    request = self.pending.get_nowait()
-                except queue.Empty:
-                    break
+            request = self._pop_request()
+            if request is None:
+                break
             if self._is_cancelled(request):
                 request.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
                 self._cancelled_effective.discard(request.request_id)
                 handled = True
                 continue
-            n = len(request.prompt_ids)
+            if self._shed_expired(request):
+                handled = True
+                continue
+            # Resumed (preempted) requests prefill their COMMITTED sequence
+            # (prompt + emitted tokens) — see _effective_prompt.
+            prompt = self._effective_prompt(request)
+            n = len(prompt)
             # Cap generation so the slot cache can hold prompt + output.
             if self.slot_capacity - n - 1 <= 0:
+                if request.parked is not None:
+                    # a request parked at the capacity edge has no room left
+                    # to decode: finish it cleanly rather than erroring a
+                    # stream the client is already consuming
+                    request.finished_at = time.monotonic()
+                    request.events.put(("done", "length"))
+                    self.metrics.record_request_done("length")
+                    handled = True
+                    continue
                 request.events.put(
                     ("error", "prompt does not fit slot capacity")
                 )
@@ -1186,11 +1542,21 @@ class EngineCore:
                 self.metrics.record_request_done("error")
                 handled = True
                 continue
+            if (budget and batch_tokens + min(n, long_cutoff) > budget
+                    and (batch or inserted)):
+                # the decode budget for this iteration is spent: the request
+                # keeps its place at the front of its class for the next one
+                self._class_queues[self._priority_of(request)].appendleft(
+                    request
+                )
+                break
             # Prompts that cannot possibly match (too short for min_prefix_len
             # after reserving one suffix token) bypass the cache silently —
             # counting them as misses would page the hit-rate-collapse alert
-            # on workloads with nothing cacheable in them.
-            if (self.prefix_cache is not None
+            # on workloads with nothing cacheable in them. Resumed requests
+            # bypass it too: their committed tokens are not a shareable
+            # prompt, and their own prompt head may already be donated.
+            if (self.prefix_cache is not None and request.parked is None
                     and n - 1 >= self.min_prefix_len):
                 # Longest cached prefix, capped at n-1 (at least one suffix
                 # token must prefill to produce the first sampled logits).
@@ -1213,7 +1579,7 @@ class EngineCore:
                         )
                         self.prefix_cache.release(entry)
                         if fresh is None:
-                            self._held_request = request  # queue on the pool
+                            self._hold_on_pool(request)
                             break
                         # no eviction point between the release above and
                         # _insert_cached's re-acquire (same thread, no pool
@@ -1226,14 +1592,22 @@ class EngineCore:
                 self.metrics.record_prefix_miss()
             pages: list[int] | None = None
             if self.page_pool is not None:
-                pages = self._try_reserve_pages(self._pages_for_tokens(n))
+                need = self._pages_for_tokens(n)
+                pages = self._try_reserve_pages(need)
+                # Page-pressure preemption: a more important request may
+                # park less important decoders (their pages free) until the
+                # reservation covers — the paged layout makes this a
+                # refcount walk, no KV bytes move.
+                while pages is None and self._preempt_for_pages(
+                        self._priority_of(request)):
+                    pages = self._try_reserve_pages(need)
                 if pages is None:
-                    self._held_request = request  # queue on the pool
+                    self._hold_on_pool(request)
                     break
             slot_id = free.pop(0)
             if self.page_pool is not None:
                 self._assign_slot_pages(slot_id, (), pages)
-            if n > max_oneshot:
+            if n > long_cutoff:
                 heavy = self._insert_long(slot_id, request, n)
                 handled = True
                 inserted += 1
@@ -1249,6 +1623,7 @@ class EngineCore:
             self.slots[slot_id].generated = 0
             self._attach_constraint(slot_id, request)
             batch.append((slot_id, request, n))
+            batch_tokens += n
 
         if not batch:
             if handled:
@@ -1261,6 +1636,7 @@ class EngineCore:
         # plan ends where dispatch begins; the prefill records below absorb
         # the accrued time via _record_step
         self._pending_plan_s += time.perf_counter() - plan_start
+        self._prefill_spent_iter = batch_tokens
         # one prefill dispatch per length bucket present in the batch
         by_bucket: dict[int, list[tuple[int, Request, int]]] = {}
         for entry in batch:
@@ -1393,10 +1769,17 @@ class EngineCore:
         self._attach_spec(slot_id, request)
         if request.compiled_constraint is None:
             return
-        state = ConstraintState(request.compiled_constraint)
+        parked = request.parked
+        if parked is not None and parked.constraint is not None:
+            # Preemption resume: the FSM cursor parked WITH the request —
+            # re-walking a fresh ConstraintState from the start state would
+            # mask the continuation as if at the beginning of the string.
+            state = parked.constraint
+        else:
+            state = ConstraintState(request.compiled_constraint)
+            self.metrics.record_structured_request()
         self.slots[slot_id].constraint = state
         self._constrained_count += 1
-        self.metrics.record_structured_request()
         self._set_mask_row(slot_id, state)
 
     def _set_mask_row(self, slot_id: int, state: ConstraintState) -> None:
@@ -1443,6 +1826,14 @@ class EngineCore:
         slot.drafter = None
         slot.spec_k = 0
         if not self._spec_available:
+            return
+        parked = request.parked
+        if parked is not None and parked.drafter is not None:
+            # resume the parked index: it already holds prompt + emitted
+            # tokens, exactly what a rebuild over the committed sequence
+            # would produce
+            slot.drafter = parked.drafter
+            slot.spec_k = parked.spec_k
             return
         knobs = request.sampling.speculative
         knobs = knobs if isinstance(knobs, dict) else {}
@@ -2030,7 +2421,7 @@ class EngineCore:
         lens = np.zeros((padded,), np.int32)
         slot_ids = np.zeros((padded,), np.int32)
         for row, (slot_id, request, n) in enumerate(group):
-            ids[row, :n] = request.prompt_ids
+            ids[row, :n] = self._effective_prompt(request)
             lens[row] = n
             slot_ids[row] = slot_id
         ids[g:] = ids[g - 1]
@@ -2158,7 +2549,19 @@ class EngineCore:
             self._seq_lens[slot_id] = n
             slot = self.slots[slot_id]
             slot.request = request
-            slot.generated = 0
+            if request.parked is not None:
+                # preemption resume: restore the generation cursor — the
+                # activation sample above IS the next token of the
+                # interrupted stream (its step folded len(committed)-1,
+                # exactly the step an uninterrupted decode would have used)
+                st = request.parked
+                slot.generated = st.generated
+                slot.out_tokens = list(st.tokens)
+                request.parked = None
+                self.metrics.record_resume()
+            else:
+                slot.generated = 0
+                slot.out_tokens = []
             # last_emit_at 0 ⇒ the first token records no inter-token gap;
             # it is emitted with the next decode fetch (first_pending).
             slot.last_emit_at = 0.0
@@ -2183,7 +2586,7 @@ class EngineCore:
             )
         padded = self._cp_bucket_for(n)
         ids = np.zeros((1, padded), np.int32)
-        ids[0, :n] = request.prompt_ids
+        ids[0, :n] = self._effective_prompt(request)
         prefill_start = time.monotonic()
         t_dispatch = time.perf_counter()
         logits, k_all, v_all = self._cp_prefill_fn(
@@ -2242,15 +2645,28 @@ class EngineCore:
             slot.generated = 0
             slot.drafter = None
             slot.spec_k = 0
+            slot.out_tokens = []
             return True
 
-        n = len(request.prompt_ids)
+        prompt = self._effective_prompt(request)
+        n = len(prompt)
         start = slot.prefill_pos
         chunk_max = self.prefill_buckets[-1]
+        prefill_budget = self._prefill_budget_now()
+        if prefill_budget:
+            # decode-token budget (docs/scheduling.md): while decoders are
+            # active, cap each chunk so decode steps interleave — a 128k
+            # prompt then costs the decoders one budget-sized prefill per
+            # iteration, never a whole drain iteration. The budget is shared
+            # with _try_insert's one-shot batch from the same iteration.
+            remaining = prefill_budget - self._prefill_spent_iter
+            if remaining <= 0:
+                return False
+            chunk_max = min(chunk_max, self._budget_chunk_len(remaining))
         chunk_len = min(chunk_max, n - start)
         bucket = self._bucket_for(chunk_len)
         ids = np.zeros((1, bucket), np.int32)
-        ids[0, :chunk_len] = request.prompt_ids[start:start + chunk_len]
+        ids[0, :chunk_len] = prompt[start:start + chunk_len]
 
         prefill_start = time.monotonic()
         t_dispatch = time.perf_counter()
@@ -2597,8 +3013,13 @@ class EngineCore:
             slot.first_pending = False
             slot.drafter = None
             slot.spec_k = 0
+            slot.out_tokens = []
             return
         slot.generated += 1
+        if token != self.eos_id:
+            # committed-sequence mirror: what a preemption park would need
+            # to chunk-prefill on resume (EOS finishes, never parks)
+            slot.out_tokens.append(token)
         # Incremental drafter update: every emitted token extends the
         # prompt-lookup index (first_pending emissions included — the first
         # token is part of the sequence the next proposal continues).
@@ -2665,6 +3086,7 @@ class EngineCore:
             slot.first_pending = False
             slot.drafter = None
             slot.spec_k = 0
+            slot.out_tokens = []
 
     def _fail_all(self, message: str) -> None:
         for slot_id, slot in enumerate(self.slots):
@@ -2682,10 +3104,16 @@ class EngineCore:
             slot.first_pending = False
             slot.drafter = None
             slot.spec_k = 0
+            slot.out_tokens = []
         if self._held_request is not None:
             self._held_request.events.put(("error", message))
             self.metrics.record_request_done("error")
             self._held_request = None
+        for p in PRIORITY_CLASSES:
+            q = self._class_queues[p]
+            while q:
+                q.popleft().events.put(("error", message))
+                self.metrics.record_request_done("error")
         while True:
             try:
                 self.pending.get_nowait().events.put(("error", message))

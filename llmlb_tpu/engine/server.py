@@ -87,7 +87,48 @@ def _sampling_from(body: dict, default_max: int = 256) -> SamplingParams:
     return SamplingParams(
         temperature=temperature, top_p=top_p, top_k=top_k,
         max_tokens=max_tokens, speculative=_speculative_from(body),
+        priority=_priority_from(body),
     )
+
+
+_PRIORITY_NAMES = {"high": 0, "normal": 1, "low": 2}
+
+
+def _priority_from(body: dict) -> int:
+    """Per-request priority class (docs/scheduling.md), accepted on both
+    the OpenAI and Anthropic dialects: "high"/"normal"/"low" or 0/1/2.
+    Lower value = more important; default "normal"."""
+    p = body.get("priority")
+    if p is None:
+        return 1
+    if isinstance(p, str):
+        if p not in _PRIORITY_NAMES:
+            raise ValueError(
+                "'priority' must be one of high, normal, low (or 0..2)"
+            )
+        return _PRIORITY_NAMES[p]
+    if isinstance(p, bool) or not isinstance(p, int) or not 0 <= p <= 2:
+        raise ValueError(
+            "'priority' must be one of high, normal, low (or 0..2)"
+        )
+    return p
+
+
+def _deadline_from(request: web.Request) -> float | None:
+    """Remaining request deadline in milliseconds, propagated by the gateway
+    (or set by a direct client) via the X-Request-Deadline-Ms header. The
+    scheduler sheds the request if it is still queued when this budget runs
+    out — work that cannot meet its deadline must not burn a prefill."""
+    raw = request.headers.get("X-Request-Deadline-Ms")
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        raise ValueError("X-Request-Deadline-Ms must be a number")
+    if ms <= 0:
+        raise ValueError("X-Request-Deadline-Ms must be positive")
+    return ms
 
 
 def _speculative_from(body: dict) -> dict | None:
@@ -305,6 +346,7 @@ class EngineAPI:
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
             kv_cache=core.kv_cache_info(), structured=core.structured_info(),
             perf=core.perf_info(), quant=core.quant_info(),
+            sched=core.sched_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -326,6 +368,8 @@ class EngineAPI:
                 "structured": self.engine.core.structured_info(),
                 # speculative decoding: config + live acceptance figures
                 "spec": self.engine.core.spec_info(),
+                # overload protection: priority queues, preemption counters
+                "sched": self.engine.core.sched_info(),
                 # live roofline: MFU / HBM-bandwidth utilization against the
                 # chip's peak specs (available only on chips in the table
                 # and once decode traffic has flowed)
@@ -493,6 +537,7 @@ class EngineAPI:
             structured = inspect_request(body)
             sampling = _sampling_from(body)
             sampling.seed = parse_seed(body)
+            sampling.deadline_ms = _deadline_from(request)
         except ValueError as e:
             return _error(400, str(e))
         if structured is not None:
@@ -645,6 +690,7 @@ class EngineAPI:
         model = body.get("model") or self.engine.model_id
         prompt_ids = self.engine.tokenizer.encode(prompt)
         sampling = _sampling_from(body, default_max=16)
+        sampling.deadline_ms = _deadline_from(request)  # middleware 400s bad values
         stops = _stops_from(body)
         completion_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -740,6 +786,7 @@ class EngineAPI:
 
         prompt_ids = self.engine.encode_chat(messages)
         sampling = _sampling_from(body)
+        sampling.deadline_ms = _deadline_from(request)
         response_id = f"resp_{uuid.uuid4().hex[:24]}"
         created = int(time.time())
         rid = _request_id_from(request)
@@ -906,6 +953,14 @@ def main(argv: list[str] | None = None) -> None:
              "prompts beyond the largest run through chunked prefill",
     )
     parser.add_argument(
+        "--prefill-chunk-budget", type=int, default=None,
+        help="max prompt tokens prefilled per step-loop iteration while "
+             "other slots are decoding (default 0 = uncapped; also via "
+             "LLMLB_PREFILL_CHUNK_BUDGET) — bounds decoder inter-token "
+             "latency regardless of arriving prompt sizes "
+             "(docs/scheduling.md)",
+    )
+    parser.add_argument(
         "--decode-burst", type=int, default=None,
         help="decode+sample steps fused per device dispatch (default: "
              "8 on TPU, 1 elsewhere; also via LLMLB_DECODE_BURST)",
@@ -1003,6 +1058,8 @@ def main(argv: list[str] | None = None) -> None:
         extra["prefill_buckets"] = buckets
     if args.decode_burst is not None:
         extra["decode_burst"] = max(1, args.decode_burst)
+    if args.prefill_chunk_budget is not None:
+        extra["prefill_chunk_budget"] = max(0, args.prefill_chunk_budget)
     if args.kv_layout is not None:
         extra["kv_layout"] = args.kv_layout
     if args.kv_page_size is not None:

@@ -350,6 +350,9 @@ class Engine:
             # speculative decoding config + live acceptance figures
             # (llmlb_tpu/spec, docs/speculative.md)
             "spec": self.core.spec_info(),
+            # overload protection: priority-queue depths, preemption and
+            # deadline-shed counters (docs/scheduling.md)
+            "sched": self.core.sched_info(),
             # live roofline (MFU / HBM-BW vs chip peaks, docs/profiling.md);
             # the gateway's telemetry-aware placement can read how close to
             # the hardware each engine is running

@@ -23,10 +23,17 @@ from aiohttp import web
 
 from llmlb_tpu.gateway.api_openai import (
     QueueTimeout,
+    StreamWriteTimeout,
+    _chat_prompt_text,
     _record,
     affinity_text_from_body,
+    deadline_at_of,
     error_response,
+    priority_label,
+    ratelimit_verdict,
     select_endpoint_with_queue,
+    stream_write_guard,
+    tenant_of,
 )
 from llmlb_tpu.gateway.balancer import prefix_affinity_hash
 from llmlb_tpu.gateway.resilience import (
@@ -147,7 +154,10 @@ def anthropic_request_to_openai(body: dict) -> dict:
                      # speculative-decoding knobs ({enabled,
                      # max_draft_tokens}) ride both dialects verbatim — the
                      # engine validates and clamps them
-                     ("speculative", "speculative")):
+                     ("speculative", "speculative"),
+                     # priority class (docs/scheduling.md): high/normal/low
+                     # or 0..2, carried verbatim — the engine validates
+                     ("priority", "priority")):
         if body.get(src) is not None:
             out[dst] = body[src]
     if body.get("stop_sequences"):
@@ -417,6 +427,28 @@ async def messages(request: web.Request) -> web.StreamResponse:
         openai_body["stream"] = True
         openai_body["stream_options"] = {"include_usage": True}
 
+    # Overload protection (docs/scheduling.md): same pipeline as
+    # proxy_openai_post — per-key token buckets, request deadline, WFQ
+    # tenant — with refusals in the Anthropic error shape.
+    try:
+        deadline_at = deadline_at_of(request, state, started)
+    except ValueError as e:
+        return _anthropic_error(400, str(e))
+    tenant, tenant_name = tenant_of(request)
+    refused = ratelimit_verdict(
+        state, request, estimate_tokens(_chat_prompt_text(openai_body))
+    )
+    if refused is not None:
+        reason, retry_after = refused
+        return _anthropic_error(
+            429,
+            f"rate limit exceeded ({reason}); retry after {retry_after}s",
+            "rate_limit_error",
+            headers={"Retry-After": str(retry_after)},
+        )
+    wfq_weight = state.admission.weight_for(tenant_name)
+    prio = priority_label(body)
+
     # Same failover loop as proxy_openai_post: re-select excluding failed
     # endpoints, retry under the attempt cap + global budget; streams fail
     # over only before the first Anthropic event reaches the client.
@@ -427,14 +459,33 @@ async def messages(request: web.Request) -> web.StreamResponse:
         ],
     )
     while True:
+        queue_timeout = (fo.config.failover_queue_timeout_s
+                         if fo.failed_ids else None)
+        if deadline_at is not None:
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                state.metrics.record_deadline_shed(canonical)
+                return _anthropic_error(
+                    504, "request deadline exceeded before an endpoint was "
+                    "available", "timeout_error",
+                )
+            cap = (queue_timeout if queue_timeout is not None
+                   else state.load_manager.queue_config.queue_timeout_s)
+            queue_timeout = min(cap, remaining)
         try:
             selection = await select_endpoint_with_queue(
                 state, canonical, capability, TpsApiKind.CHAT,
                 trace=trace, prefix_hash=prefix_hash, exclude=fo.failed_ids,
-                queue_timeout_s=(fo.config.failover_queue_timeout_s
-                                 if fo.failed_ids else None),
+                queue_timeout_s=queue_timeout,
+                tenant=tenant, weight=wfq_weight,
             )
         except QueueTimeout:
+            if deadline_at is not None and time.monotonic() >= deadline_at:
+                state.metrics.record_deadline_shed(canonical)
+                return _anthropic_error(
+                    504, "request deadline exceeded while queued",
+                    "timeout_error",
+                )
             return _anthropic_error(
                 503, "all endpoints busy", "overloaded_error",
                 headers={"Retry-After": str(retry_after_seconds(
@@ -454,6 +505,16 @@ async def messages(request: web.Request) -> web.StreamResponse:
         rid = request.get("request_id")
         if rid:
             headers[REQUEST_ID_HEADER] = rid
+        if deadline_at is not None:
+            remaining_ms = (deadline_at - time.monotonic()) * 1000.0
+            if remaining_ms <= 0:
+                lease.fail()
+                state.metrics.record_deadline_shed(canonical)
+                return _anthropic_error(
+                    504, "request deadline exceeded before forwarding",
+                    "timeout_error",
+                )
+            headers["X-Request-Deadline-Ms"] = str(max(1, int(remaining_ms)))
         if trace is not None:
             trace.begin("proxy")
         try:
@@ -507,7 +568,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
         if is_stream:
             result = await _stream_transform(
                 request, state, upstream, endpoint, canonical, started, lease,
-                body, openai_body, trace=trace, failover=fo,
+                body, openai_body, trace=trace, failover=fo, priority=prio,
             )
             if isinstance(result, PreStreamFailure):
                 fo.record_failure(endpoint, lease, "stream_pre_byte")
@@ -556,7 +617,8 @@ async def messages(request: web.Request) -> web.StreamResponse:
                                    usage["output_tokens"])
         fo.record_success(endpoint)
         # non-streaming goodput: only the TTFT target applies
-        state.metrics.record_slo(canonical, time.monotonic() - started, None)
+        state.metrics.record_slo(canonical, time.monotonic() - started, None,
+                                 priority=prio)
         _record(state, endpoint=endpoint, model=canonical,
                 api_kind=TpsApiKind.CHAT, path="/v1/messages", status=200,
                 started=started,
@@ -569,6 +631,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
 async def _stream_transform(
     request, state, upstream, endpoint, model, started, lease,
     original_body, openai_body, trace=None, failover=None,
+    priority: str = "normal",
 ) -> "web.StreamResponse | PreStreamFailure":
     # First upstream chunk is pulled BEFORE the client response is prepared:
     # a failure there is invisible to the client and fails over.
@@ -619,7 +682,12 @@ async def _stream_transform(
     # attribute walks per line on top of that.
     loads = json.loads
     encoder_feed = encoder.feed
-    resp_write = resp.write
+
+    # Slow-loris protection: the shared per-stream watchdog guard
+    # (api_openai.StreamWriteGuard) — a non-draining client aborts the
+    # pump instead of pinning the slot; no per-chunk wait_for.
+    guard = stream_write_guard(state, resp, endpoint, "/v1/messages")
+    resp_write = guard.write if guard.active() else resp.write
 
     async def pump(raw_chunk: bytes) -> None:
         nonlocal buffer
@@ -662,18 +730,35 @@ async def _stream_transform(
                     status = 502
                     error = f"stream interrupted: {type(e).__name__}"
                     upstream_failed = True
-                    await resp.write(anthropic_error_event(error))
+                    # guarded: a stalled client must not pin the handler on
+                    # the farewell frame either
+                    await resp_write(anthropic_error_event(error))
                     break
                 await pump(raw_chunk)
         if not upstream_failed:
             for event in encoder.finish():
-                await resp.write(event)
+                await resp_write(event)
+    except asyncio.CancelledError:
+        # watchdog cancel landing at a non-write await (post-race): only a
+        # fired guard converts, anything else propagates
+        if not guard.fired:
+            raise
+        status = 502
+        error = f"stream write timeout: {guard.timeout_error()}"
+        state.metrics.record_stream_write_timeout(model)
+    except StreamWriteTimeout as e:
+        # the client stopped draining (slow-loris): abort so the engine
+        # slot frees; counted, not blamed on the endpoint
+        status = 502
+        error = f"stream write timeout: {e}"
+        state.metrics.record_stream_write_timeout(model)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
             ConnectionResetError) as e:
         # client went away mid-write: not endpoint sickness
         status = 502
         error = error or f"client disconnected: {type(e).__name__}"
     finally:
+        guard.close()
         upstream.release()
         if trace is not None:
             trace.end("decode")
@@ -688,7 +773,8 @@ async def _stream_transform(
         if status == 200 and ttft_s is not None:
             itl_mean = (max(0.0, duration_s - ttft_s) / (ct - 1)
                         if ct and ct > 1 else None)
-            state.metrics.record_slo(model, ttft_s, itl_mean)
+            state.metrics.record_slo(model, ttft_s, itl_mean,
+                                     priority=priority)
         if ct:
             state.load_manager.update_tps(
                 endpoint.id, model, TpsApiKind.CHAT, ct, duration_s

@@ -111,10 +111,113 @@ def affinity_text_from_body(body: dict) -> str:
     return ""
 
 
+def _tenant_id(auth: dict | None, client_ip: str | None) -> str:
+    auth = auth or {}
+    kid = auth.get("api_key_id")
+    if kid:
+        return str(kid)
+    uid = auth.get("user_id")
+    if uid:
+        return f"user:{uid}"
+    return f"ip:{client_ip or 'unknown'}"
+
+
+def _key_name(auth: dict) -> str | None:
+    """Human key name for per-key rate-limit overrides. Every RateLimiter
+    call for a tenant must pass this — a bucket pair rebuilt after idle
+    eviction with name=None would silently fall back to the global
+    defaults, dropping the tenant's override."""
+    if not auth.get("api_key_id"):
+        return None
+    actor = auth.get("actor") or ""
+    return actor[4:] if actor.startswith("key:") else (actor or None)
+
+
+def tenant_of(request: web.Request) -> tuple[str, str | None]:
+    """(stable tenant id, human key name) for rate limiting and weighted
+    fair queuing: the API key id when one authenticated, else the user id
+    (dashboard JWT), else the client IP — so unauthenticated surfaces still
+    bucket per source."""
+    auth = request.get("auth") or {}
+    return _tenant_id(auth, request.remote), _key_name(auth)
+
+
+_PRIORITY_LABELS = {0: "high", 1: "normal", 2: "low"}
+
+
+def priority_label(body: dict) -> str:
+    """The request's priority class as a metrics label (goodput-by-priority;
+    validation proper happens at the engine)."""
+    p = body.get("priority")
+    if isinstance(p, str) and p in ("high", "normal", "low"):
+        return p
+    if isinstance(p, int) and not isinstance(p, bool):
+        return _PRIORITY_LABELS.get(p, "normal")
+    return "normal"
+
+
+def deadline_at_of(request: web.Request, state: AppState,
+                   started: float) -> float | None:
+    """Absolute monotonic deadline for this request: the client's
+    X-Request-Deadline-Ms header, else LLMLB_REQUEST_DEADLINE_MS, else
+    none. Work that cannot meet its deadline is shed before it burns a
+    prefill, and the REMAINING budget propagates to the engine on the
+    forwarded request (docs/scheduling.md). Raises ValueError (→ 400) on a
+    malformed header."""
+    raw = request.headers.get("X-Request-Deadline-Ms")
+    ms: float | None = None
+    if raw:
+        try:
+            ms = float(raw)
+        except ValueError:
+            raise ValueError("X-Request-Deadline-Ms must be a number")
+        if ms <= 0:
+            raise ValueError("X-Request-Deadline-Ms must be positive")
+    if ms is None:
+        default = state.config.request_deadline_ms
+        ms = default if default > 0 else None
+    return started + ms / 1000.0 if ms else None
+
+
+def ratelimit_verdict(state: AppState, request: web.Request,
+                      est_tokens: int) -> "tuple[str, int] | None":
+    """Shared admission check for BOTH dialects (gateway/ratelimit.py):
+    None when admitted, else (reason, retry_after_seconds) with the
+    rejection already counted — each dialect shapes its own error body."""
+    limiter = state.ratelimit
+    if limiter is None or not limiter.enabled:
+        return None
+    tenant, name = tenant_of(request)
+    verdict = limiter.acquire(tenant, name, est_tokens)
+    if verdict.allowed:
+        return None
+    reason = verdict.reason or "requests"
+    state.metrics.record_ratelimit_rejection(reason)
+    return reason, max(1, int(verdict.retry_after_s + 0.999))
+
+
+def check_ratelimit(state: AppState, request: web.Request,
+                    est_tokens: int) -> "web.Response | None":
+    """Per-API-key token buckets: a refused request gets 429 with
+    Retry-After from the bucket's computed refill time. Returns the 429
+    response (OpenAI error shape), or None when admitted."""
+    refused = ratelimit_verdict(state, request, est_tokens)
+    if refused is None:
+        return None
+    reason, retry_after = refused
+    return error_response(
+        429,
+        f"rate limit exceeded ({reason}); retry after {retry_after}s",
+        "rate_limit_error",
+        headers={"Retry-After": str(retry_after)},
+    )
+
+
 async def select_endpoint_with_queue(
     state: AppState, model: str, capability: Capability, api_kind: TpsApiKind,
     trace=None, prefix_hash: str | None = None,
     exclude: set[str] | None = None, queue_timeout_s: float | None = None,
+    tenant: str | None = None, weight: float = 1.0,
 ) -> tuple[Endpoint, str, "RequestLease"] | None:
     """Atomically TPS-select and lease an endpoint serving the model; if all
     are at the admission cap, park on the AdmissionQueue until a lease release
@@ -144,7 +247,8 @@ async def select_endpoint_with_queue(
     admit_start = time.monotonic()
     result = await state.admission.admit(get_endpoints, model, api_kind,
                                          timeout_s=queue_timeout_s,
-                                         prefix_hash=prefix_hash)
+                                         prefix_hash=prefix_hash,
+                                         tenant=tenant, weight=weight)
     if not result.admitted:
         state.metrics.record_queue_timeout(model)
         state.metrics.record_queue_wait(model, "none", result.waited_s)
@@ -208,6 +312,14 @@ def _record(
             state, endpoint.id, model, api_kind,
             error=status >= 400, prompt_tokens=prompt_tokens,
             completion_tokens=completion_tokens, duration_ms=duration_ms,
+        )
+    if (state.ratelimit is not None and state.ratelimit.enabled
+            and completion_tokens > 0):
+        # post-paid token debit: the admission check could only estimate the
+        # prompt; the completion throttles this tenant's NEXT request
+        state.ratelimit.charge_tokens(
+            _tenant_id(auth, client_ip), completion_tokens,
+            name=_key_name(auth),
         )
 
 
@@ -283,6 +395,23 @@ async def proxy_openai_post(
     stored_body = sanitize_request_body(body)
     is_stream = bool(body.get("stream"))
 
+    # ---- overload protection (docs/scheduling.md) ------------------------
+    # Per-key token buckets first: a greedy tenant's excess load bounces
+    # with 429 + honest Retry-After before it can queue in front of anyone.
+    # Then the request deadline: the admission wait is capped at the
+    # remaining budget, and expiry sheds the request (504) before it burns
+    # a prefill — the remaining budget rides to the engine on the header.
+    try:
+        deadline_at = deadline_at_of(request, state, started)
+    except ValueError as e:
+        return error_response(400, str(e))
+    refused = check_ratelimit(state, request, estimate_tokens(prompt_text))
+    if refused is not None:
+        return refused
+    tenant, tenant_name = tenant_of(request)
+    wfq_weight = state.admission.weight_for(tenant_name)
+    prio = priority_label(body)
+
     # Failover loop: each attempt re-selects (excluding endpoints that
     # already failed this request), and a failed attempt retries on another
     # endpoint with backoff while the attempt cap and global retry budget
@@ -296,14 +425,33 @@ async def proxy_openai_post(
         ],
     )
     while True:
+        queue_timeout = (fo.config.failover_queue_timeout_s
+                         if fo.failed_ids else None)
+        if deadline_at is not None:
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                state.metrics.record_deadline_shed(canonical)
+                return error_response(
+                    504, "request deadline exceeded before an endpoint "
+                    "was available", "timeout_error",
+                )
+            cap = (queue_timeout if queue_timeout is not None
+                   else state.load_manager.queue_config.queue_timeout_s)
+            queue_timeout = min(cap, remaining)
         try:
             selection = await select_endpoint_with_queue(
                 state, canonical, capability, api_kind, trace=trace,
                 prefix_hash=prefix_hash, exclude=fo.failed_ids,
-                queue_timeout_s=(fo.config.failover_queue_timeout_s
-                                 if fo.failed_ids else None),
+                queue_timeout_s=queue_timeout,
+                tenant=tenant, weight=wfq_weight,
             )
         except QueueTimeout as qt:
+            if deadline_at is not None and time.monotonic() >= deadline_at:
+                state.metrics.record_deadline_shed(canonical)
+                return error_response(
+                    504, "request deadline exceeded while queued for an "
+                    "endpoint", "timeout_error",
+                )
             return error_response(
                 503,
                 f"all endpoints busy; queue timeout exceeded "
@@ -340,6 +488,18 @@ async def proxy_openai_post(
         if rid:
             # the engine scheduler adopts this id, joining the gateway trace
             headers[REQUEST_ID_HEADER] = rid
+        if deadline_at is not None:
+            remaining_ms = (deadline_at - time.monotonic()) * 1000.0
+            if remaining_ms <= 0:
+                lease.fail()
+                state.metrics.record_deadline_shed(canonical)
+                return error_response(
+                    504, "request deadline exceeded before forwarding",
+                    "timeout_error",
+                )
+            # the engine sheds the request if it is still queued there when
+            # this remaining budget runs out (docs/scheduling.md)
+            headers["X-Request-Deadline-Ms"] = str(max(1, int(remaining_ms)))
 
         if trace is not None:
             trace.begin("proxy")
@@ -407,7 +567,7 @@ async def proxy_openai_post(
             result = await _forward_stream(
                 request, state, upstream, endpoint, canonical, api_kind, path,
                 started, lease, prompt_text, client_ip, auth, stored_body,
-                trace=trace, failover=fo,
+                trace=trace, failover=fo, priority=prio,
             )
             if isinstance(result, PreStreamFailure):
                 fo.record_failure(endpoint, lease, "stream_pre_byte")
@@ -475,7 +635,8 @@ async def proxy_openai_post(
         if api_kind in (TpsApiKind.CHAT, TpsApiKind.COMPLETION,
                         TpsApiKind.RESPONSES):
             state.metrics.record_slo(canonical,
-                                     time.monotonic() - started, None)
+                                     time.monotonic() - started, None,
+                                     priority=prio)
         state.events.publish("MetricsUpdated", {"endpoint_id": endpoint.id})
         return web.Response(
             body=raw, status=200,
@@ -498,10 +659,110 @@ def sse_error_frame(message: str, code: str = "stream_interrupted") -> bytes:
     ).encode()
 
 
+class StreamWriteTimeout(Exception):
+    """A client write stalled past LLMLB_STREAM_WRITE_TIMEOUT: the reader
+    stopped draining the SSE stream (slow-loris). The pump aborts — which
+    releases the upstream response and thereby cancels the engine slot —
+    instead of holding a decode slot hostage for the inference timeout."""
+
+
+class StreamWriteGuard:
+    """Slow-loris protection for the per-chunk SSE hot loop, shared by the
+    OpenAI passthrough and the Anthropic transform (docs/scheduling.md).
+
+    ONE watchdog timer per STREAM instead of an asyncio.wait_for per chunk:
+    the guarded write costs two timestamp assignments on the fast path — no
+    Task/TimerHandle allocation per chunk, so the loop PR 9 reduced to one
+    C scan + one socket write stays that way. The watchdog wakes every
+    timeout/2; a write pending past the timeout cancels the pump task and
+    `write` converts that cancellation into StreamWriteTimeout (worst-case
+    detection latency 1.5x the configured timeout). A cancellation that
+    lands after the write completed surfaces at the pump's next await —
+    pumps must check `fired` in their CancelledError handler.
+
+    The stalled_reader fault rule (gateway/faults.py) simulates a
+    non-draining client as a deterministic sleep inside the guarded write,
+    so the timeout is testable without real sockets."""
+
+    __slots__ = ("_resp", "_timeout", "_stall_rules", "_loop", "_task",
+                 "_handle", "_pending_since", "fired", "_sent")
+
+    def __init__(self, resp, timeout: float, stall_rules=()):
+        self._resp = resp
+        self._timeout = timeout
+        # Every fired rule applies (like upstream_post), each stalling once
+        # when the stream passes its after_bytes threshold.
+        self._stall_rules = sorted(stall_rules, key=lambda r: r.after_bytes)
+        self._loop = asyncio.get_running_loop()
+        self._task = asyncio.current_task()
+        self._pending_since: float | None = None
+        self.fired = False
+        self._sent = 0
+        self._handle = (self._loop.call_later(timeout / 2, self._check)
+                        if timeout > 0 else None)
+
+    def active(self) -> bool:
+        """False when neither timeout nor fault applies — callers then keep
+        the raw resp.write bound method in the hot loop."""
+        return self._timeout > 0 or bool(self._stall_rules)
+
+    def _check(self) -> None:
+        started = self._pending_since
+        if (started is not None
+                and self._loop.time() - started > self._timeout):
+            self.fired = True
+            self._handle = None
+            self._task.cancel()
+            return
+        self._handle = self._loop.call_later(self._timeout / 2, self._check)
+
+    def close(self) -> None:
+        """Disarm the watchdog (call from the pump's finally)."""
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    def timeout_error(self) -> StreamWriteTimeout:
+        return StreamWriteTimeout(
+            f"client stopped reading for {self._timeout:.0f}s"
+        )
+
+    async def write(self, data: bytes) -> None:
+        self._pending_since = self._loop.time()
+        try:
+            while (self._stall_rules
+                   and self._sent >= self._stall_rules[0].after_bytes):
+                rule = self._stall_rules.pop(0)
+                await asyncio.sleep(rule.latency_ms / 1000.0)
+            await self._resp.write(data)
+        except asyncio.CancelledError:
+            if self.fired:
+                raise self.timeout_error() from None
+            raise
+        finally:
+            self._pending_since = None
+        self._sent += len(data)
+
+
+def stream_write_guard(state: AppState, resp, endpoint,
+                       path: str) -> StreamWriteGuard:
+    """Build the guard for one stream: configured timeout + every matching
+    stalled_reader fault rule (each counted as injected and applied)."""
+    stall_rules = []
+    if state.faults is not None:
+        for rule in state.faults.decide(endpoint, path,
+                                        kinds=("stalled_reader",)):
+            state.metrics.record_fault_injected(rule.kind)
+            stall_rules.append(rule)
+    return StreamWriteGuard(resp, state.config.stream_write_timeout_s,
+                            stall_rules)
+
+
 async def _forward_stream(
     request, state: AppState, upstream, endpoint, model, api_kind, path,
     started, lease, prompt_text, client_ip, auth, stored_body=None,
     trace=None, failover: FailoverController | None = None,
+    priority: str = "normal",
 ) -> "web.StreamResponse | PreStreamFailure":
     """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120).
 
@@ -510,7 +771,10 @@ async def _forward_stream(
     caller, nothing was sent). After the first byte the stream is committed —
     an upstream cut emits a final `event: error` frame, counts against the
     endpoint (breaker + balancer per-endpoint stats), and records 502; a
-    client disconnect counts against nobody."""
+    client disconnect counts against nobody. Every client write runs under
+    LLMLB_STREAM_WRITE_TIMEOUT (docs/scheduling.md): a reader that stops
+    draining aborts the stream (freeing the engine slot) instead of pinning
+    it until the inference timeout."""
     iterator = upstream.content.iter_any()
     first_chunk: bytes | None = None
     try:
@@ -539,6 +803,9 @@ async def _forward_stream(
     timeline = (TokenTimeline()
                 if trace is not None and state.traces.sample_timeline()
                 else None)
+    # Slow-loris protection (StreamWriteGuard): one watchdog per stream, a
+    # non-draining client aborts the pump instead of pinning the slot.
+    guard = stream_write_guard(state, resp, endpoint, path)
     ttft_s: float | None = None
     status = 200
     error = None
@@ -548,17 +815,19 @@ async def _forward_stream(
             observe_first_token(state, trace, model, endpoint.name,
                                 started, streaming=True)
             ttft_s = time.monotonic() - started
-            acc.feed(first_chunk)
-            await resp.write(first_chunk)
-            if timeline is not None and b"data:" in first_chunk:
-                timeline.mark()
+            feed = acc.feed
             # Per-chunk hot loop: with the native scanner built, each chunk
             # costs one C scan (frame split + usage extract) and one socket
             # write — bound methods hoisted so the loop does no attribute
             # walks, and the timeline branch is a single identity test
-            # unless this request was sampled for a token timeline.
-            feed = acc.feed
-            write = resp.write
+            # unless this request was sampled for a token timeline. The
+            # guarded write adds two timestamp stores per chunk (the
+            # watchdog timer is per-stream, never per-chunk).
+            write = guard.write if guard.active() else resp.write
+            feed(first_chunk)
+            await write(first_chunk)
+            if timeline is not None and b"data:" in first_chunk:
+                timeline.mark()
             next_chunk = iterator.__anext__
             while True:
                 try:
@@ -572,12 +841,30 @@ async def _forward_stream(
                     status = 502
                     error = f"stream interrupted: {type(e).__name__}"
                     upstream_failed = True
-                    await resp.write(sse_error_frame(error))
+                    # guarded: a stalled client must not pin the handler on
+                    # the farewell frame either
+                    await write(sse_error_frame(error))
                     break
                 feed(chunk)
                 await write(chunk)
                 if timeline is not None and b"data:" in chunk:
                     timeline.mark()
+    except asyncio.CancelledError:
+        # the watchdog's cancel can land at any await once it fires (e.g.
+        # the next upstream read, if the write completed in the race) —
+        # only a fired guard converts; anything else propagates
+        if not guard.fired:
+            raise
+        status = 502
+        error = f"stream write timeout: {guard.timeout_error()}"
+        state.metrics.record_stream_write_timeout(model)
+    except StreamWriteTimeout as e:
+        # the client stopped draining (slow-loris): abort the stream — the
+        # upstream release below closes the engine connection, which
+        # cancels the slot — and count it. Not endpoint sickness.
+        status = 502
+        error = f"stream write timeout: {e}"
+        state.metrics.record_stream_write_timeout(model)
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
             ConnectionResetError) as e:
         # resp.write failed: the CLIENT went away — not endpoint sickness,
@@ -585,6 +872,7 @@ async def _forward_stream(
         status = 502
         error = error or f"client disconnected: {type(e).__name__}"
     finally:
+        guard.close()
         upstream.release()
         if trace is not None:
             trace.end("decode")
@@ -604,7 +892,8 @@ async def _forward_stream(
             # responses: only the TTFT target applies)
             itl_mean = (max(0.0, duration_s - ttft_s) / (ct - 1)
                         if ct > 1 else None)
-            state.metrics.record_slo(model, ttft_s, itl_mean)
+            state.metrics.record_slo(model, ttft_s, itl_mean,
+                                     priority=priority)
         if ct > 0:
             state.load_manager.update_tps(
                 endpoint.id, model, api_kind, ct, duration_s

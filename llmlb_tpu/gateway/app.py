@@ -554,8 +554,11 @@ async def _api_health(request: web.Request) -> web.Response:
         "admission": {
             "queue_depth": state.admission.queue_depth(),
             "active_requests": state.load_manager.total_active(),
+            "wfq_enabled": state.admission.wfq_enabled,
         },
     }
+    if state.ratelimit is not None and state.ratelimit.enabled:
+        body["ratelimit"] = state.ratelimit.snapshot()
     if state.worker.multi:
         body["worker"] = {"index": state.worker.index,
                           "count": state.worker.count}

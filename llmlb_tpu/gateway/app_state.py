@@ -33,12 +33,14 @@ from llmlb_tpu.gateway.balancer import (
 )
 from llmlb_tpu.gateway.config import (
     QueueConfig,
+    RateLimitConfig,
     ResilienceConfig,
     ServerConfig,
     SloConfig,
     env_bool,
     env_float,
     env_int,
+    wfq_weights_from_env,
 )
 from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
@@ -47,6 +49,7 @@ from llmlb_tpu.gateway.gate import InferenceGate
 from llmlb_tpu.gateway.gossip import GossipBus, default_gossip_dir
 from llmlb_tpu.gateway.health import EndpointHealthChecker
 from llmlb_tpu.gateway.metrics import GatewayMetrics
+from llmlb_tpu.gateway.ratelimit import RateLimiter
 from llmlb_tpu.gateway.registry import EndpointRegistry
 from llmlb_tpu.gateway.resilience import ResilienceManager
 from llmlb_tpu.gateway.tracing import TraceStore
@@ -190,6 +193,9 @@ class AppState:
     traces: TraceStore
     resilience: ResilienceManager | None = None
     faults: FaultInjector | None = None
+    # Per-API-key token buckets (gateway/ratelimit.py, docs/scheduling.md);
+    # always constructed — zero hot-path work unless limits are configured.
+    ratelimit: RateLimiter | None = None
     health_checker: EndpointHealthChecker | None = None
     update_manager: object | None = None  # set by gateway.update
     tray: object | None = None  # TrayController when LLMLB_TRAY=1
@@ -237,6 +243,11 @@ async def build_app_state(
         affinity_mode=default_affinity_mode(worker.count),
     )
     admission = AdmissionQueue(load_manager)
+    # Weighted fair queuing (docs/scheduling.md): per-tenant virtual-time
+    # ordering of the contended admission queue; LLMLB_WFQ=0 restores the
+    # historical pure-FIFO order.
+    admission.wfq_enabled = env_bool("LLMLB_WFQ", True)
+    admission.weights = wfq_weights_from_env()
     events = DashboardEventBus()
     gate = InferenceGate()
     audit = AuditLog(db)
@@ -299,6 +310,10 @@ async def build_app_state(
     load_manager.resilience = resilience
     faults = FaultInjector.from_env()
 
+    # Per-API-key rate limits: worker-local, conservative (limits divide by
+    # the worker count — the group never exceeds the configured rate).
+    ratelimit = RateLimiter(RateLimitConfig.from_env(), workers=worker.count)
+
     # Per-request history/daily-stat writes: synchronous single-worker (the
     # historical behavior), batched when N workers share the WAL file or
     # when LLMLB_HISTORY_FLUSH_SECS opts in explicitly.
@@ -313,7 +328,7 @@ async def build_app_state(
         admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
         metrics=metrics, traces=traces, resilience=resilience, faults=faults,
-        worker=worker, history=history,
+        ratelimit=ratelimit, worker=worker, history=history,
     )
 
     _seed_tps_from_daily_stats(state)

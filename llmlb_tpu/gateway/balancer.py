@@ -37,6 +37,7 @@ Behavior parity with the reference scheduler (reference balancer/mod.rs):
 from __future__ import annotations
 
 import asyncio
+import bisect
 import dataclasses
 import hashlib
 import os
@@ -861,10 +862,14 @@ RECHECK_INTERVAL_S = 1.0
 
 
 class _Ticket:
-    __slots__ = ("future",)
+    __slots__ = ("future", "vtime", "seq", "tenant")
 
-    def __init__(self):
+    def __init__(self, vtime: float = 0.0, seq: int = 0,
+                 tenant: str | None = None):
         self.future: "asyncio.Future | None" = None
+        self.vtime = vtime
+        self.seq = seq
+        self.tenant = tenant
 
 
 class AdmissionQueue:
@@ -872,21 +877,39 @@ class AdmissionQueue:
     wake, replacing a 50 ms poll loop (parity: the reference's notify-based
     begin_request/WaitResult machinery, balancer/mod.rs:2273-2427).
 
-    FIFO-fair: tickets queue in arrival order; a release wakes every parked
-    waiter (the event loop then runs their retries in queue order, so the
-    oldest waiter gets first claim on the freed slot). Wakes arriving from
-    other threads (e.g. a lease released by a GC finalizer) are marshalled
-    onto the owning event loop with call_soon_threadsafe.
+    Weighted fair queuing (docs/scheduling.md): each parked ticket carries a
+    virtual finish time — ``max(vclock, tenant's last vtime) + 1/weight`` —
+    and the queue is kept sorted by it. A release wakes every parked waiter
+    IN THAT ORDER (the event loop runs their retries in wake order, so the
+    smallest-vtime ticket gets first claim on the freed slot): a tenant that
+    queued 50 requests advances its own virtual clock 50 steps, so another
+    tenant's next request slots in right behind the greedy tenant's FIRST
+    ticket, not its fiftieth — each tenant saturates only its own share of
+    the contended queue. With one tenant (or ``wfq_enabled=False`` via
+    LLMLB_WFQ=0) the order degenerates to exact arrival FIFO, the historical
+    behavior. Wakes arriving from other threads (e.g. a lease released by a
+    GC finalizer) are marshalled onto the owning event loop with
+    call_soon_threadsafe.
     """
 
     def __init__(self, manager: LoadManager):
         self.manager = manager
-        self._tickets: deque[_Ticket] = deque()
+        self._tickets: list[_Ticket] = []  # sorted by (vtime, seq)
         self._loop: "asyncio.AbstractEventLoop | None" = None
         # GatewayMetrics, attached by app_state: counts admission
         # re-attempts by parked waiters, labeled by API kind.
         self.metrics = None
+        # WFQ state: app_state loads weights from LLMLB_WFQ_WEIGHTS and the
+        # enable flag from LLMLB_WFQ (default on).
+        self.wfq_enabled = True
+        self.weights: dict[str, float] = {}
+        self._vclock = 0.0
+        self._seq = 0
+        self._tenant_vtime: dict[str, float] = {}
         manager.on_release = self._on_release
+
+    def weight_for(self, tenant_name: str | None) -> float:
+        return self.weights.get(tenant_name or "", 1.0)
 
     # ---------------------------------------------------------------- waking
 
@@ -922,6 +945,42 @@ class AdmissionQueue:
     def queue_depth(self) -> int:
         return len(self._tickets)
 
+    def _enqueue(self, tenant: str | None, weight: float) -> _Ticket:
+        """Assign the ticket's virtual finish time and insert in vtime
+        order. FIFO mode (wfq_enabled=False) stamps the arrival sequence
+        instead — bit-identical to the historical queue."""
+        self._seq += 1
+        if not self.wfq_enabled:
+            ticket = _Ticket(vtime=float(self._seq), seq=self._seq,
+                             tenant=tenant)
+        else:
+            key = tenant or ""
+            vtime = max(self._vclock, self._tenant_vtime.get(key, 0.0))
+            vtime += 1.0 / max(0.01, weight)
+            self._tenant_vtime[key] = vtime
+            ticket = _Ticket(vtime=vtime, seq=self._seq, tenant=tenant)
+        bisect.insort(self._tickets, ticket,
+                      key=lambda t: (t.vtime, t.seq))
+        return ticket
+
+    def _dequeue(self, ticket: _Ticket, serviced: bool) -> None:
+        try:
+            self._tickets.remove(ticket)
+        except ValueError:
+            return
+        if serviced and self.wfq_enabled:
+            self._vclock = max(self._vclock, ticket.vtime)
+        if not any(t.tenant == ticket.tenant for t in self._tickets):
+            # Last queued ticket for this tenant: drop its clock entry
+            # unconditionally. Serviced tickets already advanced _vclock, so
+            # the entry is redundant; UNserviced exits (queue timeout,
+            # deadline shed, disconnect) incurred no fairness debt — keeping
+            # a vtime ahead of the vclock would both penalize the tenant's
+            # next request for work it never received and leak one map entry
+            # per tenant whose last wait timed out (ip-keyed tenants make
+            # that unbounded under exactly the overload that forms queues).
+            self._tenant_vtime.pop(ticket.tenant or "", None)
+
     async def admit(
         self,
         get_endpoints,
@@ -929,12 +988,16 @@ class AdmissionQueue:
         api_kind: TpsApiKind,
         timeout_s: float | None = None,
         prefix_hash: str | None = None,
+        tenant: str | None = None,
+        weight: float = 1.0,
     ) -> WaitResult:
         """Admit onto the best endpoint, parking until a slot frees or the
         queue timeout passes. `get_endpoints` is re-invoked on every retry so
         registry changes (recovered/added endpoints) are picked up.
         `prefix_hash` biases selection toward the endpoint whose prefix KV
-        cache is warm for this prompt head."""
+        cache is warm for this prompt head. `tenant`/`weight` feed the
+        weighted-fair queue order — the uncontended fast path below never
+        touches WFQ state, so fairness costs nothing until there is a queue."""
         start = time.monotonic()
         got = self.manager.try_admit(get_endpoints(), model, api_kind,
                                      prefix_hash)
@@ -945,8 +1008,8 @@ class AdmissionQueue:
             timeout_s = self.manager.queue_config.queue_timeout_s
         self._loop = asyncio.get_running_loop()
         deadline = start + timeout_s
-        ticket = _Ticket()
-        self._tickets.append(ticket)
+        ticket = self._enqueue(tenant, weight)
+        serviced = False
         try:
             while True:
                 remaining = deadline - time.monotonic()
@@ -973,13 +1036,11 @@ class AdmissionQueue:
                 got = self.manager.try_admit(get_endpoints(), model, api_kind,
                                              prefix_hash)
                 if got is not None:
+                    serviced = True
                     return WaitResult(
                         admitted=True, endpoint=got[0], lease=got[1],
                         queue_position=self.position(ticket),
                         waited_s=time.monotonic() - start,
                     )
         finally:
-            try:
-                self._tickets.remove(ticket)
-            except ValueError:
-                pass
+            self._dequeue(ticket, serviced)

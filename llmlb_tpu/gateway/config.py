@@ -189,6 +189,91 @@ class SloConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RateLimitConfig:
+    """Per-API-key token-bucket rate limits (gateway/ratelimit.py).
+
+    Two buckets per tenant: requests/second (burst-capped) and tokens/minute
+    (prompt estimate debited at admission, completion tokens debited after
+    the response — the bucket may go negative, throttling the NEXT request).
+    A refused request gets 429 with Retry-After computed from the bucket's
+    refill rate. Defaults are 0 = unlimited; per-key overrides by API-key
+    name (or id):
+
+        LLMLB_RATELIMIT_RPS        default requests/second per key (0 = off)
+        LLMLB_RATELIMIT_BURST      bucket size (default 2x rps, min 1)
+        LLMLB_RATELIMIT_TPM        default tokens/minute per key (0 = off)
+        LLMLB_RATELIMIT_OVERRIDES  JSON per-key overrides, e.g.
+                                   {"bulk-batch": {"rps": 1, "tpm": 6000}}
+
+    Multi-worker: state is worker-local and limits divide by the worker
+    count (each worker enforces limit/N), so the group as a whole never
+    admits more than the configured rate — conservative, like retry
+    budgets; never gossiped.
+    """
+
+    requests_per_s: float = 0.0
+    burst: float = 0.0  # 0 -> 2x rps (min 1)
+    tokens_per_min: float = 0.0
+    overrides: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.requests_per_s > 0 or self.tokens_per_min > 0
+                or bool(self.overrides))
+
+    @classmethod
+    def from_env(cls) -> "RateLimitConfig":
+        overrides: dict = {}
+        raw = env_str("LLMLB_RATELIMIT_OVERRIDES", "")
+        if raw:
+            import json
+
+            try:
+                parsed = json.loads(raw)
+                for key, t in parsed.items():
+                    # keep ONLY the keys the operator wrote: an absent key
+                    # inherits the global default, an explicit 0 means
+                    # unlimited for that key (see RateLimiter._limits_for)
+                    overrides[str(key)] = {
+                        k: float(t[k]) for k in ("rps", "burst", "tpm")
+                        if k in t
+                    }
+            except (ValueError, AttributeError, TypeError):
+                logging.getLogger("llmlb_tpu.gateway.config").warning(
+                    "LLMLB_RATELIMIT_OVERRIDES=%r is not a JSON object of "
+                    '{"key": {"rps": N, "burst": N, "tpm": N}}; ignoring',
+                    raw,
+                )
+                overrides = {}
+        return cls(
+            requests_per_s=env_float("LLMLB_RATELIMIT_RPS", 0.0),
+            burst=env_float("LLMLB_RATELIMIT_BURST", 0.0),
+            tokens_per_min=env_float("LLMLB_RATELIMIT_TPM", 0.0),
+            overrides=overrides,
+        )
+
+
+def wfq_weights_from_env() -> dict[str, float]:
+    """LLMLB_WFQ_WEIGHTS: JSON of per-tenant weights for the weighted fair
+    admission queue, keyed by API-key name (default weight 1.0). A weight-2
+    tenant drains twice as fast through a contended queue."""
+    raw = env_str("LLMLB_WFQ_WEIGHTS", "")
+    if not raw:
+        return {}
+    import json
+
+    try:
+        parsed = json.loads(raw)
+        return {str(k): max(0.01, float(v)) for k, v in parsed.items()}
+    except (ValueError, AttributeError, TypeError):
+        logging.getLogger("llmlb_tpu.gateway.config").warning(
+            'LLMLB_WFQ_WEIGHTS=%r is not a JSON object of {"key": weight}; '
+            "ignoring", raw,
+        )
+        return {}
+
+
+@dataclasses.dataclass(frozen=True)
 class ServerConfig:
     host: str = "0.0.0.0"
     port: int = 32768  # reference default port
@@ -203,6 +288,13 @@ class ServerConfig:
     admin_password: str | None = None
     auto_sync_interval_s: float = 300.0
     update_drain_timeout_s: float = 300.0
+    # Slow-loris protection: an SSE write that cannot reach the client
+    # within this many seconds aborts the stream (freeing the engine slot)
+    # instead of pinning it for the full inference timeout. 0 disables.
+    stream_write_timeout_s: float = 30.0
+    # Default request deadline in ms applied when the client sends none
+    # (X-Request-Deadline-Ms header wins). 0 = no default deadline.
+    request_deadline_ms: float = 0.0
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -226,4 +318,8 @@ class ServerConfig:
             admin_password=env_str("LLMLB_ADMIN_PASSWORD"),
             auto_sync_interval_s=env_float("LLMLB_AUTO_SYNC_INTERVAL", 300.0),
             update_drain_timeout_s=env_float("LLMLB_UPDATE_DRAIN_TIMEOUT", 300.0),
+            stream_write_timeout_s=env_float(
+                "LLMLB_STREAM_WRITE_TIMEOUT", 30.0
+            ),
+            request_deadline_ms=env_float("LLMLB_REQUEST_DEADLINE_MS", 0.0),
         )

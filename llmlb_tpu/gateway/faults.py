@@ -29,7 +29,15 @@ import threading
 
 import aiohttp
 
-VALID_KINDS = ("connect_refused", "latency", "http", "stream_cut")
+VALID_KINDS = ("connect_refused", "latency", "http", "stream_cut",
+               "stalled_reader")
+
+# Kinds applied at the upstream POST boundary (resilience.upstream_post);
+# stalled_reader is applied in the CLIENT-side stream pump instead — it
+# simulates a reader that stops draining the SSE stream (after_bytes sets
+# the stall point, latency_ms the stall duration), which the pump's write
+# timeout must catch (docs/scheduling.md slow-loris protection).
+UPSTREAM_KINDS = ("connect_refused", "latency", "http", "stream_cut")
 
 
 @dataclasses.dataclass
@@ -183,13 +191,19 @@ class FaultInjector:
         with self._lock:
             self._rules.clear()
 
-    def decide(self, endpoint, path: str) -> list[FaultRule]:
+    def decide(self, endpoint, path: str,
+               kinds: tuple[str, ...] | None = None) -> list[FaultRule]:
         """All rules that fire for this upstream call, in table order.
         Counters advance per *matching* call, so `every_n` is deterministic
-        regardless of what other endpoints are doing."""
+        regardless of what other endpoints are doing. `kinds` restricts
+        which rule kinds this call site applies (rules outside it neither
+        fire nor advance their counters here — the stream pump and the
+        upstream POST each consult their own kinds exactly once)."""
         fired: list[FaultRule] = []
         with self._lock:
             for rule in self._rules:
+                if kinds is not None and rule.kind not in kinds:
+                    continue
                 if not rule.matches(endpoint, path):
                     continue
                 if rule.max_fires is not None and rule.fires >= rule.max_fires:
@@ -213,8 +227,12 @@ class FaultInjector:
                     "kind": r.kind, "endpoint": r.endpoint, "path": r.path,
                     "every_n": r.every_n, "probability": r.probability,
                     "status": r.status if r.kind == "http" else None,
-                    "latency_ms": r.latency_ms if r.kind == "latency" else None,
-                    "after_bytes": (r.after_bytes if r.kind == "stream_cut"
+                    "latency_ms": (r.latency_ms
+                                   if r.kind in ("latency", "stalled_reader")
+                                   else None),
+                    "after_bytes": (r.after_bytes
+                                    if r.kind in ("stream_cut",
+                                                  "stalled_reader")
                                     else None),
                     "seen": r.seen, "fires": r.fires,
                 }

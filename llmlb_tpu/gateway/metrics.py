@@ -30,6 +30,13 @@ SLO goodput series (targets from SloConfig, docs/profiling.md):
   llmlb_gateway_slo_ttft_miss_total{model}  counter
   llmlb_gateway_slo_itl_miss_total{model}   counter
   llmlb_gateway_goodput_ratio{model}        gauge (met / eligible)
+overload-protection series (docs/scheduling.md):
+  llmlb_gateway_slo_priority_eligible_total{priority}  counter
+  llmlb_gateway_slo_priority_met_total{priority}       counter
+  llmlb_gateway_goodput_by_priority{priority}          gauge
+  llmlb_gateway_ratelimit_rejections_total{reason}     counter (429s)
+  llmlb_gateway_deadline_shed_total{model}             counter
+  llmlb_gateway_stream_write_timeouts_total{model}     counter
 plus scrape-time gauges (active requests, admission queue depth, event-bus
 drops, trace-buffer size) injected by the /metrics handler.
 """
@@ -126,6 +133,18 @@ class GatewayMetrics:
         self._slo_met: dict[str, int] = defaultdict(int)
         self._slo_ttft_miss: dict[str, int] = defaultdict(int)
         self._slo_itl_miss: dict[str, int] = defaultdict(int)
+        # goodput BY PRIORITY CLASS (docs/scheduling.md): the figure that
+        # shows overload protection working — high-priority goodput holding
+        # while low-priority traffic absorbs the squeeze
+        self._slo_prio_eligible: dict[str, int] = defaultdict(int)
+        self._slo_prio_met: dict[str, int] = defaultdict(int)
+        # overload protection (docs/scheduling.md): requests refused by the
+        # per-key token buckets, requests shed because their deadline had
+        # already passed, and streams aborted by the write timeout
+        # (stalled/slow-loris clients)
+        self._ratelimit_rejections: dict[str, int] = defaultdict(int)
+        self._deadline_shed: dict[str, int] = defaultdict(int)
+        self._stream_write_timeouts: dict[str, int] = defaultdict(int)
 
     # ------------------------------------------------------------ recorders
 
@@ -200,8 +219,27 @@ class GatewayMetrics:
         with self._lock:
             self._structured_rejected += 1
 
+    def record_ratelimit_rejection(self, reason: str) -> None:
+        """One 429 from the per-key token buckets; reason is 'requests'
+        (rps bucket) or 'tokens' (tokens/minute bucket)."""
+        with self._lock:
+            self._ratelimit_rejections[reason] += 1
+
+    def record_deadline_shed(self, model: str) -> None:
+        """A request shed at the gateway because its deadline had already
+        passed (queue wait ate the budget) — no prefill was burned."""
+        with self._lock:
+            self._deadline_shed[model] += 1
+
+    def record_stream_write_timeout(self, model: str) -> None:
+        """A stream aborted because the client stopped draining it for
+        longer than the write timeout (slow-loris protection)."""
+        with self._lock:
+            self._stream_write_timeouts[model] += 1
+
     def record_slo(self, model: str, ttft_s: float | None,
-                   itl_mean_s: float | None) -> None:
+                   itl_mean_s: float | None,
+                   priority: str | None = None) -> None:
         """Judge one SUCCESSFUL inference request against its model's SLO
         targets. `ttft_s` is client-observed time to first byte/response;
         `itl_mean_s` is the mean inter-token gap over the stream (None for
@@ -222,6 +260,10 @@ class GatewayMetrics:
                 self._slo_itl_miss[model] += 1
             if not (ttft_miss or itl_miss):
                 self._slo_met[model] += 1
+            if priority is not None:
+                self._slo_prio_eligible[priority] += 1
+                if not (ttft_miss or itl_miss):
+                    self._slo_prio_met[priority] += 1
 
     def _observe(self, table: dict, buckets: tuple[float, ...],
                  model: str, endpoint: str, seconds: float) -> None:
@@ -278,6 +320,15 @@ class GatewayMetrics:
                 "structured_rejected_total": self._structured_rejected,
                 "slo_eligible_total": sum(self._slo_eligible.values()),
                 "slo_met_total": sum(self._slo_met.values()),
+                "ratelimit_rejections_total":
+                    sum(self._ratelimit_rejections.values()),
+                "deadline_shed_total": sum(self._deadline_shed.values()),
+                "stream_write_timeouts_total":
+                    sum(self._stream_write_timeouts.values()),
+                "goodput_by_priority": {
+                    prio: round(self._slo_prio_met.get(prio, 0) / n, 4)
+                    for prio, n in self._slo_prio_eligible.items() if n
+                },
                 "goodput_ratio": (
                     round(sum(self._slo_met.values())
                           / sum(self._slo_eligible.values()), 4)
@@ -403,6 +454,48 @@ class GatewayMetrics:
                         f'llmlb_gateway_goodput_ratio'
                         f'{{model="{_escape(model)}"}} {round(ratio, 6)}'
                     )
+            for fam, table in (
+                ("llmlb_gateway_slo_priority_eligible_total",
+                 self._slo_prio_eligible),
+                ("llmlb_gateway_slo_priority_met_total", self._slo_prio_met),
+            ):
+                lines.append(f"# TYPE {fam} counter")
+                for prio, n in sorted(table.items()):
+                    lines.append(
+                        f'{fam}{{priority="{_escape(prio)}"}} {n}'
+                    )
+            lines.append(
+                "# TYPE llmlb_gateway_goodput_by_priority gauge"
+            )
+            for prio, eligible in sorted(self._slo_prio_eligible.items()):
+                if eligible > 0:
+                    ratio = self._slo_prio_met.get(prio, 0) / eligible
+                    lines.append(
+                        f'llmlb_gateway_goodput_by_priority'
+                        f'{{priority="{_escape(prio)}"}} {round(ratio, 6)}'
+                    )
+            lines.append(
+                "# TYPE llmlb_gateway_ratelimit_rejections_total counter"
+            )
+            for reason, n in sorted(self._ratelimit_rejections.items()):
+                lines.append(
+                    f'llmlb_gateway_ratelimit_rejections_total'
+                    f'{{reason="{_escape(reason)}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_deadline_shed_total counter")
+            for model, n in sorted(self._deadline_shed.items()):
+                lines.append(
+                    f'llmlb_gateway_deadline_shed_total'
+                    f'{{model="{_escape(model)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_stream_write_timeouts_total counter"
+            )
+            for model, n in sorted(self._stream_write_timeouts.items()):
+                lines.append(
+                    f'llmlb_gateway_stream_write_timeouts_total'
+                    f'{{model="{_escape(model)}"}} {n}'
+                )
             for name, table in (
                 ("llmlb_gateway_ttft_seconds", self._ttft),
                 ("llmlb_gateway_e2e_seconds", self._e2e),

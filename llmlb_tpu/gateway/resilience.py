@@ -613,8 +613,11 @@ async def upstream_post(state, endpoint, path: str, *, json=None, data=None,
     fault-injection rules (faults.py) at this boundary: added latency,
     connect-refused, synthetic HTTP status, or a stream cut after K bytes —
     each counted in /metrics so a chaos run is observable."""
+    from llmlb_tpu.gateway.faults import UPSTREAM_KINDS
+
     faults = state.faults
-    fired = faults.decide(endpoint, path) if faults is not None else ()
+    fired = (faults.decide(endpoint, path, kinds=UPSTREAM_KINDS)
+             if faults is not None else ())
     cut_rule = None
     for rule in fired:
         state.metrics.record_fault_injected(rule.kind)

@@ -900,6 +900,350 @@ async def run_spec_bench(requests: int) -> dict:
     }
 
 
+async def _make_named_key(gw, name: str) -> str:
+    """A second inference API key so the slo-mix workload has distinct
+    tenants (rate-limit overrides key by API-key name)."""
+    resp = await gw.client.post(
+        "/api/api-keys",
+        json={"name": name,
+              "permissions": ["openai.inference", "openai.models.read"]},
+        headers=await gw.admin_headers(),
+    )
+    assert resp.status == 201, await resp.text()
+    return (await resp.json())["api_key"]
+
+
+def _gap_stats(gaps: list[float]) -> dict:
+    """p50/p99/max over inter-token gaps, plus the fraction of gaps that
+    would blow a 250 ms ITL target — the per-gap view a mean hides."""
+    if not gaps:
+        return {"n": 0}
+    s = sorted(gaps)
+    return {
+        "n": len(s),
+        "p50_ms": round(s[len(s) // 2] * 1000, 1),
+        "p99_ms": round(s[min(len(s) - 1, int(len(s) * 0.99))] * 1000, 1),
+        "max_ms": round(s[-1] * 1000, 1),
+        "frac_over_250ms": round(
+            sum(1 for g in s if g > 0.25) / len(s), 4
+        ),
+    }
+
+
+async def run_slo_mix_bench(requests: int) -> dict:
+    """SLO-mix workload (docs/scheduling.md): the adversarial tenant mix
+    overload protection exists for, through the full gateway against a real
+    tpu:// engine (CPU backend). Three labeled sub-scenarios matching the
+    acceptance bar:
+
+    (a) itl_bound — background streams decode while a batch of long
+        prompts (the CPU-scaled stand-in for a 128k arrival; debug-tiny
+        caps positions at 512) prefills, with the chunk budget off vs on.
+        Reports client-measured inter-token gap p99/max for the background
+        decoders: off shows the prefill spike, on bounds it.
+    (b) ratelimit — one greedy API key fires concurrent waves against a
+        per-key token bucket while a background tenant trickles requests:
+        the greedy key's excess 429s with honest Retry-After, the
+        background tenant's goodput holds at 1.0.
+    (c) preemption — a low-priority stream on a single-slot engine is
+        parked by a high-priority arrival and resumes; its final text must
+        be identical to an uninterrupted reference run.
+
+    Goodput (PR 6's SLO machinery, by priority class) is the reported
+    figure, not raw throughput. Wall-clock numbers are CPU-host bound and
+    not TPU-transferable; the mechanisms (chunk interleaving, bucket math,
+    park/resume identity) are.
+    """
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+    from llmlb_tpu.gateway.config import RateLimitConfig
+    from llmlb_tpu.gateway.ratelimit import RateLimiter
+    from tests.support import GatewayHarness
+
+    LONG_CHARS = 420  # ByteTokenizer: ~1 token/char; slot capacity is 512
+    CHUNK_BUDGET = 16
+    # Prompts probed to decode long (no early EOS) under the seed-0 random
+    # weights — greedy on a random tiny model stops whenever EOS wins the
+    # argmax, so background decoders must be prompts that keep emitting.
+    BG_PROMPTS = (
+        "background chat 0", "background chat 3",
+        "lorem ipsum dolor sit amet", "alpha bravo charlie delta",
+    )
+
+    async def stream_chat(gw, headers, content, *, priority, max_tokens,
+                          marks: list | None = None) -> dict:
+        """One streaming chat; records the arrival time of every content
+        delta into `marks` (client-side ITL ground truth)."""
+        payload = {
+            "model": "bench-slo",
+            "messages": [{"role": "user", "content": content}],
+            "max_tokens": max_tokens, "temperature": 0.0, "stream": True,
+            "priority": priority,
+        }
+        t0 = time.perf_counter()
+        text, ttft = "", None
+        resp = await gw.client.post("/v1/chat/completions", json=payload,
+                                    headers=headers)
+        assert resp.status == 200, await resp.text()
+        async for raw in resp.content:
+            line = raw.decode(errors="replace").strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            for c in chunk.get("choices", []):
+                delta = c.get("delta", {}).get("content")
+                if delta:
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    if marks is not None:
+                        marks.append(now)
+                    text += delta
+        await resp.release()
+        return {"text": text, "ttft_s": ttft}
+
+    # ---------------------------------------------- (a) ITL bound on/off
+    async def itl_mode(budget: int) -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-slo", num_slots=8,
+            slot_capacity=512, prefill_buckets=(16, 32, 64, 128, 256),
+            kv_layout="paged", kv_page_size=16, seed=0,
+            prefill_chunk_budget=budget, prefix_cache=False,
+        )
+        eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(f"http://127.0.0.1:{eng_server.port}",
+                             [engine.model_id])
+            headers = await gw.inference_headers()
+            # warm every compiled shape outside the measured window: one
+            # background-shaped stream (its prefill bucket + decode) and
+            # one long prompt (256-chunk path, or budget-sized chunks)
+            await stream_chat(gw, headers, BG_PROMPTS[0], priority="high",
+                              max_tokens=8)
+            await stream_chat(gw, headers, "x" * LONG_CHARS, priority="low",
+                              max_tokens=2)
+
+            marks: list[list[float]] = [[] for _ in BG_PROMPTS]
+            bg = [
+                asyncio.create_task(stream_chat(
+                    gw, headers, prompt, priority="high",
+                    max_tokens=160, marks=marks[i],
+                ))
+                for i, prompt in enumerate(BG_PROMPTS)
+            ]
+            ready_by = time.monotonic() + 120.0
+            while any(len(m) < 3 for m in marks):  # all decoding for real
+                if time.monotonic() > ready_by:
+                    raise RuntimeError(
+                        "background streams never reached steady decode"
+                    )
+                await asyncio.sleep(0.005)
+            prefills_before = engine.core.metrics.prefill_step.n
+            t_long = time.perf_counter()
+            longs = await asyncio.gather(*(
+                stream_chat(gw, headers, "x" * LONG_CHARS, priority="low",
+                            max_tokens=4)
+                for _ in range(3)
+            ))
+            long_wall = time.perf_counter() - t_long
+            await asyncio.gather(*bg)
+            prefill_steps = engine.core.metrics.prefill_step.n - prefills_before
+            gaps = [b - a for m in marks for a, b in zip(m, m[1:])]
+            return {
+                "prefill_chunk_budget": budget,
+                "background_streams": len(bg),
+                "long_prompts": len(longs),
+                "long_prompt_tokens_each": LONG_CHARS,
+                "long_wall_s": round(long_wall, 2),
+                "prefill_dispatches_for_longs": prefill_steps,
+                "background_itl": _gap_stats(gaps),
+                "gateway_goodput_by_priority":
+                    gw.state.metrics.summary()["goodput_by_priority"],
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    itl_off = await itl_mode(0)
+    itl_on = await itl_mode(CHUNK_BUDGET)
+
+    # ------------------------------------------------- (b) rate limiting
+    async def ratelimit_phase() -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-slo", num_slots=8,
+            slot_capacity=128, prefill_buckets=(16, 32, 64),
+            prefix_cache=False, seed=0,
+        )
+        eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(f"http://127.0.0.1:{eng_server.port}",
+                             [engine.model_id])
+            greedy_key = await _make_named_key(gw, "greedy")
+            bg_key = await _make_named_key(gw, "background")
+            rps, burst = 2.0, 2.0
+            gw.state.ratelimit = RateLimiter(RateLimitConfig(
+                overrides={"greedy": {"rps": rps, "burst": burst,
+                                      "tpm": 0.0}},
+            ))
+
+            def body(prio):
+                return {"model": "bench-slo",
+                        "messages": [{"role": "user", "content": "ping"}],
+                        "max_tokens": 8, "temperature": 0.0,
+                        "priority": prio}
+
+            async def greedy_wave(n):
+                resps = await asyncio.gather(*(
+                    gw.client.post("/v1/chat/completions", json=body("low"),
+                                   headers={"Authorization":
+                                            f"Bearer {greedy_key}"})
+                    for _ in range(n)
+                ))
+                out = []
+                for r in resps:
+                    retry_after = r.headers.get("Retry-After")
+                    await r.release()
+                    out.append((r.status, retry_after))
+                return out
+
+            async def background_trickle(n):
+                ok = 0
+                for _ in range(n):
+                    r = await gw.client.post(
+                        "/v1/chat/completions", json=body("high"),
+                        headers={"Authorization": f"Bearer {bg_key}"})
+                    ok += int(r.status == 200)
+                    await r.release()
+                    await asyncio.sleep(0.25)
+                return ok
+
+            # warm the engine shapes before the timed window
+            await background_trickle(1)
+
+            waves = max(4, requests // 6)
+            t0 = time.perf_counter()
+            bg_task = asyncio.create_task(background_trickle(8))
+            greedy_results = []
+            for _ in range(waves):
+                greedy_results += await greedy_wave(6)
+                await asyncio.sleep(0.4)
+            bg_ok = await bg_task
+            elapsed = time.perf_counter() - t0
+
+            granted = [r for r in greedy_results if r[0] == 200]
+            refused = [r for r in greedy_results if r[0] == 429]
+            fair_share = burst + rps * elapsed
+            summary = gw.state.metrics.summary()
+            return {
+                "greedy_limits": {"rps": rps, "burst": burst},
+                "elapsed_s": round(elapsed, 2),
+                "greedy_fired": len(greedy_results),
+                "greedy_granted": len(granted),
+                "greedy_429": len(refused),
+                "greedy_fair_share_cap": round(fair_share, 1),
+                "greedy_within_share": len(granted) <= fair_share + 1,
+                "all_429_carry_retry_after": all(
+                    ra is not None and int(ra) >= 1 for _, ra in refused
+                ),
+                "background_requests": 9,
+                "background_ok": bg_ok + 1,  # incl. the warmup request
+                "gateway_ratelimit_rejections":
+                    summary["ratelimit_rejections_total"],
+                "gateway_goodput_by_priority":
+                    summary["goodput_by_priority"],
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    ratelimit = await ratelimit_phase()
+
+    # --------------------------------------- (c) preemption + resume
+    async def preemption_phase() -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-slo",
+            num_slots=1, slot_capacity=128, prefill_buckets=(16, 32),
+            kv_layout="paged", kv_page_size=16, prefix_cache=False, seed=0,
+        )
+        eng_server = TestServer(create_engine_app(engine, owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(f"http://127.0.0.1:{eng_server.port}",
+                             [engine.model_id])
+            headers = await gw.inference_headers()
+            victim = "the quick brown fox jumps over"
+
+            # uninterrupted reference (single slot, nothing else running)
+            ref = await stream_chat(gw, headers, victim, priority="low",
+                                    max_tokens=48)
+
+            before = engine.core.metrics.preemptions_total
+            marks: list[float] = []
+            task = asyncio.create_task(stream_chat(
+                gw, headers, victim, priority="low", max_tokens=48,
+                marks=marks,
+            ))
+            ready_by = time.monotonic() + 120.0
+            while len(marks) < 2:  # decoding, past first_pending
+                if time.monotonic() > ready_by:
+                    raise RuntimeError("victim stream never started decoding")
+                await asyncio.sleep(0.005)
+            hi = await stream_chat(gw, headers, "interloper",
+                                   priority="high", max_tokens=6)
+            got = await task
+            m = engine.core.metrics
+            return {
+                "preemptions": m.preemptions_total - before,
+                "resumes": m.preempt_resumes_total,
+                "victim_tokens": len(got["text"]),
+                "interloper_tokens": len(hi["text"]),
+                "token_identical_resume": got["text"] == ref["text"],
+                "engine_sched": engine.core.sched_info(),
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    preempt = await preemption_phase()
+
+    passed = (
+        itl_on["background_itl"]["max_ms"]
+        < itl_off["background_itl"]["max_ms"]
+        and itl_on["prefill_dispatches_for_longs"]
+        > itl_off["prefill_dispatches_for_longs"]
+        and ratelimit["greedy_429"] > 0
+        and ratelimit["greedy_within_share"]
+        and ratelimit["all_429_carry_retry_after"]
+        and ratelimit["background_ok"] == ratelimit["background_requests"]
+        and preempt["preemptions"] >= 1
+        and preempt["token_identical_resume"]
+    )
+    return {
+        "metric": "slo_mix_workload",
+        "passed": passed,
+        "itl_bound": {"budget_off": itl_off, "budget_on": itl_on},
+        "ratelimit": ratelimit,
+        "preemption": preempt,
+        "caveats": (
+            "CPU host, debug-tiny model (512-position cap): the 'long' "
+            "prompt is a 420-token stand-in for a 128k arrival and all "
+            "wall-clock figures are CPU-bound; the mechanisms measured "
+            "(chunk-budget interleaving, token-bucket shares, park/resume "
+            "identity) transfer, the absolute latencies do not."
+        ),
+    }
+
+
 def _run_stub_server(port: int) -> None:
     """Hidden mode: a minimal OpenAI-compatible stub engine in its own
     process, so gateway workers under test never share a Python runtime
@@ -1658,7 +2002,8 @@ def main() -> None:
     parser.add_argument(
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
-                 "structured", "spec-decode", "quantized", "throughput"),
+                 "structured", "spec-decode", "quantized", "throughput",
+                 "slo-mix"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
@@ -1703,6 +2048,12 @@ def main() -> None:
         result = asyncio.run(run_spec_bench(args.requests))
     elif args.workload == "mixed-length":
         result = asyncio.run(run_mixed_length_bench(args.requests))
+    elif args.workload == "slo-mix":
+        result = asyncio.run(run_slo_mix_bench(args.requests))
+        print(json.dumps(result))
+        if not result["passed"]:
+            sys.exit(1)
+        return
     elif args.workload == "quantized":
         if args.requests < 40:
             # the peak-concurrency measurement needs enough requests to

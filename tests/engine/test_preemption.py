@@ -1,0 +1,317 @@
+"""Overload protection: priority classes, preemption park/resume identity,
+chunked-prefill decode budget, deadline shedding (docs/scheduling.md).
+
+The load-bearing guarantee is BIT-IDENTITY: a preempted request — parked
+under pressure (pages released, grammar cursor and drafter retained) and
+resumed later via a chunk-prefill of its committed tokens — must emit
+exactly the token stream an uninterrupted run would have. Greedy is
+deterministic outright; seeded stochastic holds because sample keys fold
+PRNGKey(seed) by ABSOLUTE position, independent of batch composition.
+Covered over paged and dense KV layouts and with speculative decoding on.
+"""
+
+import asyncio
+import json
+import time
+
+import jsonschema
+import pytest
+
+from llmlb_tpu.engine.presets import get_preset
+from llmlb_tpu.engine.scheduler import EngineCore, Request, SamplingParams
+from llmlb_tpu.engine.service import Engine
+
+# Every value is bounded (enum, not bare integer: unbounded digit runs
+# would let greedy emit digits past max_tokens and length-cut the JSON),
+# so the grammar must reach its accepting state and force EOS.
+SCHEMA = {
+    "type": "object",
+    "properties": {"name": {"type": "string", "maxLength": 8},
+                   "n": {"enum": [0, 1, 2, 3]}},
+    "required": ["name", "n"],
+}
+
+
+# One slot: the victim owns it, so a high-priority arrival MUST preempt —
+# no scheduling ambiguity about which slot parks.
+@pytest.fixture(scope="module", params=["paged", "dense", "paged-spec"])
+def engine(request):
+    layout = "dense" if request.param == "dense" else "paged"
+    extra = {"spec_decode": True} if request.param == "paged-spec" else {}
+    eng = Engine.from_preset(
+        "debug-tiny", num_slots=1, slot_capacity=128,
+        prefill_buckets=(16, 32), seed=0,
+        kv_layout=layout, kv_page_size=16, **extra,
+    )
+    yield eng
+    eng.shutdown()
+
+
+async def _consume(agen, out: list):
+    async for delta in agen:
+        out.append(delta)
+
+
+async def _wait_for_text(out: list, min_chars: int, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while sum(len(d.text) for d in out) < min_chars:
+        assert time.monotonic() < deadline, "victim stream produced no text"
+        await asyncio.sleep(0.005)
+
+
+def _text(out: list) -> str:
+    return "".join(d.text for d in out)
+
+
+async def _preempt_roundtrip(eng, victim_params: SamplingParams,
+                             prompt="the quick brown fox jumps over"):
+    """Run the victim alone (reference), then again with a high-priority
+    interloper forcing a park/resume; return (reference_text, victim_text,
+    preemptions_delta)."""
+    ids = eng.tokenizer.encode(prompt)
+    ref = await eng.complete(ids, victim_params)
+
+    before = eng.core.metrics.preemptions_total
+    out: list = []
+    task = asyncio.create_task(
+        _consume(eng.stream(ids, victim_params), out)
+    )
+    await _wait_for_text(out, 2)  # decoding, past first_pending
+    hi = await eng.complete(
+        eng.tokenizer.encode("interloper"),
+        SamplingParams(temperature=0.0, max_tokens=6, priority=0),
+    )
+    assert hi.finish_reason in ("stop", "length")
+    await task
+    return ref.text, _text(out), eng.core.metrics.preemptions_total - before
+
+
+def test_park_resume_greedy_token_identity(engine):
+    async def run():
+        ref, got, preempted = await _preempt_roundtrip(
+            engine, SamplingParams(temperature=0.0, max_tokens=48,
+                                   priority=2),
+        )
+        assert preempted >= 1, "high-priority arrival did not preempt"
+        assert got == ref
+        assert engine.core.metrics.preempt_resumes_total >= 1
+    asyncio.run(run())
+
+
+def test_park_resume_seeded_stochastic_identity(engine):
+    async def run():
+        ref, got, preempted = await _preempt_roundtrip(
+            engine, SamplingParams(temperature=0.9, seed=1234,
+                                   max_tokens=48, priority=2),
+        )
+        assert preempted >= 1
+        assert got == ref
+    asyncio.run(run())
+
+
+def test_constraint_cursor_parks_and_resumes(engine):
+    """ROADMAP 2c residual: a parked constrained slot's ConstraintState
+    cursor must park and resume WITH the request — a re-walk from the FSM
+    start state would emit a second JSON document opener mid-stream."""
+    async def run():
+        params = SamplingParams(
+            temperature=0.0, max_tokens=96, priority=2,
+            constraint={"type": "json_schema", "schema": SCHEMA},
+        )
+        violations_before = engine.core.metrics.constraint_violations_total
+        ref, got, preempted = await _preempt_roundtrip(engine, params)
+        assert preempted >= 1
+        assert got == ref
+        jsonschema.validate(json.loads(got), SCHEMA)
+        assert (engine.core.metrics.constraint_violations_total
+                == violations_before)
+    asyncio.run(run())
+
+
+def test_midstream_page_exhaustion_parks_instead_of_finishing():
+    """A tiny page pool forced mid-decode exhaustion to finish requests at
+    'length' pre-preemption; now the loser parks and resumes, completing
+    token-identical to an uncontended run."""
+    eng = Engine.from_preset(
+        "debug-tiny", num_slots=2, slot_capacity=64,
+        prefill_buckets=(16,), seed=0, kv_layout="paged", kv_page_size=8,
+        kv_pages=9,  # trash page + 8: two growing decoders cannot both fit
+        prefix_cache=False,
+    )
+    try:
+        async def run():
+            params = SamplingParams(temperature=0.0, max_tokens=24)
+            a_ids = eng.tokenizer.encode("alpha alpha")
+            b_ids = eng.tokenizer.encode("beta beta")
+            ref_a = await eng.complete(a_ids, params)
+            ref_b = await eng.complete(b_ids, params)
+            got_a, got_b = await asyncio.gather(
+                eng.complete(a_ids, params), eng.complete(b_ids, params)
+            )
+            assert got_a.text == ref_a.text
+            assert got_b.text == ref_b.text
+            assert got_a.finish_reason == ref_a.finish_reason
+            assert got_b.finish_reason == ref_b.finish_reason
+        asyncio.run(run())
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_chunk_budget_interleaves_and_is_token_identical():
+    """With the budget on and a decoder active, a one-shot-sized prompt
+    runs as multiple budget-sized chunks (decode steps between), and the
+    output is token-identical to the unbudgeted engine."""
+    def build(budget):
+        return Engine.from_preset(
+            "debug-tiny", num_slots=2, slot_capacity=256,
+            prefill_buckets=(16, 32, 64, 128), seed=0,
+            kv_layout="paged", kv_page_size=16,
+            prefill_chunk_budget=budget, prefix_cache=False,
+        )
+
+    async def run_long(eng):
+        """(prefill steps spent on the long prompt, its text, whether the
+        background decoder was still decoding when the long one finished —
+        the chunk-count assertion only holds while a decoder is active, so
+        callers must check it before trusting the step count)."""
+        bg_out: list = []
+        bg = asyncio.create_task(_consume(
+            eng.stream(eng.tokenizer.encode("background decoder"),
+                       SamplingParams(temperature=0.0, max_tokens=220)),
+            bg_out,
+        ))
+        await _wait_for_text(bg_out, 2)
+        before = eng.core.metrics.prefill_step.n
+        long_ids = eng.tokenizer.encode("x" * 100)  # > 64, <= 128 bucket
+        result = await eng.complete(
+            long_ids, SamplingParams(temperature=0.0, max_tokens=8)
+        )
+        steps = eng.core.metrics.prefill_step.n - before
+        bg_alive = not bg.done()
+        bg.cancel()
+        try:
+            await bg
+        except asyncio.CancelledError:
+            pass
+        return steps, result.text, bg_alive
+
+    eng_budget = build(32)
+    eng_free = build(0)
+    try:
+        async def run():
+            # On a contended host the background decoder (220 tokens) can
+            # drain before the long prompt's chunks finish, releasing the
+            # budget mid-prefill; retry a couple of times and only assert
+            # the chunk count when the decoder survived the whole window.
+            for _ in range(3):
+                steps_b, text_b, bg_alive = await run_long(eng_budget)
+                if bg_alive:
+                    break
+            steps_f, text_f, _ = await run_long(eng_free)
+            assert text_b == text_f
+            assert steps_f == 1, f"expected one-shot prefill, got {steps_f}"
+            if not bg_alive:
+                pytest.skip("background decoder finished before the long "
+                            "prompt on every attempt (contended host); "
+                            "chunk-count assertion not meaningful")
+            # 100 tokens at a 32-token budget: at least 4 chunked dispatches
+            # vs exactly 1 one-shot dispatch unbudgeted
+            assert steps_b >= 4, f"expected chunked prefill, got {steps_b}"
+        asyncio.run(run())
+    finally:
+        eng_budget.shutdown()
+        eng_free.shutdown()
+
+
+# ------------------------------------------------- scheduler-level units
+
+
+@pytest.fixture(scope="module")
+def cold_core():
+    """An EngineCore whose step loop is NEVER started: _try_insert and the
+    class queues can be driven deterministically by hand."""
+    core = EngineCore(get_preset("debug-tiny"), num_slots=2,
+                      slot_capacity=64, prefill_buckets=(16,),
+                      prefix_cache=False)
+    yield core
+    core._fail_all("test over")
+
+
+def _req(prio=1, deadline_ms=None, tokens=(1, 2, 3)):
+    return Request(
+        prompt_ids=list(tokens),
+        sampling=SamplingParams(temperature=0.0, max_tokens=4,
+                                priority=prio, deadline_ms=deadline_ms),
+    )
+
+
+def test_class_queues_pop_strictly_by_priority(cold_core):
+    reqs = [_req(2), _req(0), _req(1), _req(0)]
+    for r in reqs:
+        cold_core.pending.put(r)
+    cold_core._drain_pending()
+    depths = cold_core.queue_class_depths()
+    assert depths == {"high": 2, "normal": 1, "low": 1}
+    order = [cold_core._pop_request() for _ in range(4)]
+    assert order == [reqs[1], reqs[3], reqs[2], reqs[0]]
+    assert cold_core._pop_request() is None
+
+
+def test_pop_prefers_more_important_class_over_held(cold_core):
+    """A low-priority request wedged on the page pool (held) must not block
+    a high-priority arrival — its page-pressure preemption is the very
+    thing that can unwedge the pool (priority inversion regression)."""
+    low, hi = _req(2), _req(0)
+    cold_core._held_request = low
+    cold_core._class_queues[0].append(hi)
+    assert cold_core._head_priority() == 0
+    assert cold_core._pop_request() is hi
+    # the held request still owns the front of its own class
+    assert cold_core._pop_request() is low
+    assert cold_core._held_request is None
+    assert cold_core._pop_request() is None
+
+
+def test_hold_on_pool_never_overwrites_held(cold_core):
+    a, b = _req(2), _req(0)
+    cold_core._hold_on_pool(a)
+    cold_core._hold_on_pool(b)  # second hold requeues, never drops `a`
+    assert cold_core._held_request is a
+    assert cold_core._pop_request() is b
+    assert cold_core._pop_request() is a
+    assert cold_core._pop_request() is None
+
+
+def test_expired_deadline_is_shed_before_prefill(cold_core):
+    req = _req(deadline_ms=1.0)
+    time.sleep(0.01)
+    cold_core.pending.put(req)
+    shed_before = cold_core.metrics.deadline_shed_total
+    assert cold_core._try_insert() is True  # handled work: the shed
+    kind, value = req.events.get_nowait()
+    assert kind == "error" and "deadline" in str(value)
+    assert cold_core.metrics.deadline_shed_total == shed_before + 1
+    # no slot was claimed, no dispatch ran
+    assert all(s.request is None for s in cold_core.slots)
+
+
+def test_sched_info_and_metrics_render(cold_core):
+    info = cold_core.sched_info()
+    assert set(info["queued_by_class"]) == {"high", "normal", "low"}
+    text = cold_core.metrics.render(
+        queue_depth=0, active_slots=0, num_slots=2,
+        sched=cold_core.sched_info(),
+    )
+    assert "llmlb_engine_preemptions_total" in text
+    assert "llmlb_engine_deadline_shed_total" in text
+    assert 'llmlb_engine_queue_depth_class{priority="high"}' in text
+
+
+def test_plan_wire_priority_and_deadline_survive():
+    """Belt and braces on top of test_plan_wire's generic probe: the two
+    new fields ride dataclasses.asdict -> SamplingParams(**payload)."""
+    import dataclasses
+
+    s = SamplingParams(priority=2, deadline_ms=1500.0)
+    back = SamplingParams(**dataclasses.asdict(s))
+    assert back.priority == 2 and back.deadline_ms == 1500.0

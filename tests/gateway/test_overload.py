@@ -1,0 +1,299 @@
+"""Gateway overload protection: per-key token buckets (429 + Retry-After),
+weighted fair queuing, request deadlines, and slow-loris stream-write
+timeouts (docs/scheduling.md). Tier-1, fully in-process.
+"""
+
+import asyncio
+import dataclasses
+import time
+
+from llmlb_tpu.gateway.balancer import AdmissionQueue, LoadManager
+from llmlb_tpu.gateway.config import QueueConfig, RateLimitConfig
+from llmlb_tpu.gateway.faults import FaultInjector, FaultRule
+from llmlb_tpu.gateway.ratelimit import RateLimiter, TokenBucket
+from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+CHAT = "/v1/chat/completions"
+
+
+def _chat_body(model="mock-model", stream=False, **extra):
+    body = {"model": model,
+            "messages": [{"role": "user", "content": "ping"}], **extra}
+    if stream:
+        body["stream"] = True
+    return body
+
+
+# ------------------------------------------------------------ bucket units
+
+
+def test_token_bucket_take_refill_and_retry_after():
+    b = TokenBucket(rate_per_s=10.0, burst=2.0)
+    now = time.monotonic()
+    assert b.take(1.0, now) == 0.0
+    assert b.take(1.0, now) == 0.0
+    wait = b.take(1.0, now)  # empty: 1 token at 10/s = 0.1s away
+    assert 0.09 <= wait <= 0.11
+    assert b.take(1.0, now + 0.2) == 0.0  # refilled
+
+
+def test_token_bucket_postpaid_charge_goes_negative():
+    b = TokenBucket(rate_per_s=1.0, burst=5.0)
+    now = time.monotonic()
+    b.charge(20.0, now)  # completion tokens debit unconditionally
+    wait = b.take(1.0, now)
+    assert wait >= 15.0  # deep in debt: next request throttled hard
+
+
+def test_ratelimiter_rps_and_overrides_and_worker_division():
+    cfg = RateLimitConfig(requests_per_s=2.0, burst=2.0,
+                          overrides={"bulk": {"rps": 1.0, "burst": 1.0,
+                                              "tpm": 0.0}})
+    rl = RateLimiter(cfg)
+    assert rl.acquire("k1", "normal-key").allowed
+    assert rl.acquire("k1", "normal-key").allowed
+    refused = rl.acquire("k1", "normal-key")
+    assert not refused.allowed and refused.reason == "requests"
+    assert refused.retry_after_s > 0
+    # override keyed by name: only 1 burst
+    assert rl.acquire("k2", "bulk").allowed
+    assert not rl.acquire("k2", "bulk").allowed
+    # two workers: each enforces half the configured rate
+    rl2 = RateLimiter(cfg, workers=2)
+    assert rl2.acquire("k3", None).allowed
+    assert not rl2.acquire("k3", None).allowed  # burst 2/2 = 1
+
+
+def test_ratelimiter_tokens_per_minute_and_postpaid():
+    cfg = RateLimitConfig(tokens_per_min=600.0)  # bucket burst = 600
+    rl = RateLimiter(cfg)
+    assert rl.acquire("k", None, est_tokens=500).allowed
+    refused = rl.acquire("k", None, est_tokens=500)
+    assert not refused.allowed and refused.reason == "tokens"
+    assert refused.retry_after_s > 10  # 400 missing tokens at 10/s
+    rl.charge_tokens("k", 1000)  # post-paid completion debit
+    refused = rl.acquire("k", None, est_tokens=1)
+    assert not refused.allowed and refused.retry_after_s > 60
+
+
+# --------------------------------------------------------------- WFQ units
+
+
+def _ep(name: str) -> Endpoint:
+    return Endpoint(name=name, base_url=f"http://{name}:1")
+
+
+async def _wfq_order(weights=None, wfq=True):
+    """Park 3 waiters for tenant A then 1 for tenant B behind a
+    single-slot endpoint; return the service order."""
+    lm = LoadManager(QueueConfig(max_active_per_endpoint=1))
+    q = AdmissionQueue(lm)
+    q.wfq_enabled = wfq
+    q.weights = weights or {}
+    a = _ep("a")
+    gate = await q.admit(lambda: [a], "m", TpsApiKind.CHAT, timeout_s=1.0)
+    assert gate.admitted
+    order: list[str] = []
+
+    async def waiter(label: str, tenant: str):
+        res = await q.admit(lambda: [a], "m", TpsApiKind.CHAT,
+                            timeout_s=5.0, tenant=tenant,
+                            weight=q.weight_for(tenant))
+        assert res.admitted
+        order.append(label)
+        await asyncio.sleep(0.01)
+        res.lease.complete()
+
+    tasks = []
+    for i in range(3):
+        tasks.append(asyncio.create_task(waiter(f"A{i}", "A")))
+        await asyncio.sleep(0.01)
+    tasks.append(asyncio.create_task(waiter("B", "B")))
+    await asyncio.sleep(0.01)
+    assert q.queue_depth() == 4
+    gate.lease.complete()
+    await asyncio.gather(*tasks)
+    return order
+
+
+def test_wfq_interleaves_tenants():
+    """The greedy tenant's 3 queued requests advance its virtual clock, so
+    the light tenant's single request slots in right behind A's FIRST."""
+    assert asyncio.run(_wfq_order()) == ["A0", "B", "A1", "A2"]
+
+
+def test_wfq_weight_preference():
+    assert asyncio.run(_wfq_order(weights={"B": 4.0})) == [
+        "B", "A0", "A1", "A2"
+    ]
+
+
+def test_wfq_disabled_restores_fifo():
+    assert asyncio.run(_wfq_order(wfq=False)) == ["A0", "A1", "A2", "B"]
+
+
+# ------------------------------------------------------------- HTTP level
+
+
+def test_ratelimit_429_with_retry_after_both_dialects():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            gw.state.ratelimit = RateLimiter(
+                RateLimitConfig(requests_per_s=0.5, burst=1.0)
+            )
+            headers = await gw.inference_headers()
+            ok = await gw.client.post(CHAT, json=_chat_body(),
+                                      headers=headers)
+            assert ok.status == 200
+            refused = await gw.client.post(CHAT, json=_chat_body(),
+                                           headers=headers)
+            assert refused.status == 429
+            assert int(refused.headers["Retry-After"]) >= 1
+            body = await refused.json()
+            assert body["error"]["type"] == "rate_limit_error"
+            # Anthropic dialect: same buckets, Anthropic error shape
+            key = await gw.inference_key()
+            refused2 = await gw.client.post(
+                "/v1/messages",
+                json={"model": "mock-model", "max_tokens": 8,
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"x-api-key": key},
+            )
+            assert refused2.status == 429
+            body2 = await refused2.json()
+            assert body2["type"] == "error"
+            assert body2["error"]["type"] == "rate_limit_error"
+            assert "Retry-After" in refused2.headers
+            summary = gw.state.metrics.summary()
+            assert summary["ratelimit_rejections_total"] == 2
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_deadline_header_propagates_to_engine():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+            headers["X-Request-Deadline-Ms"] = "5000"
+            resp = await gw.client.post(CHAT, json=_chat_body(),
+                                        headers=headers)
+            assert resp.status == 200
+            fwd = mock.headers_seen[-1]["X-Request-Deadline-Ms"]
+            assert 0 < int(fwd) <= 5000
+            # malformed header is a client error, not a proxy attempt
+            bad = dict(await gw.inference_headers())
+            bad["X-Request-Deadline-Ms"] = "soon"
+            resp = await gw.client.post(CHAT, json=_chat_body(),
+                                        headers=bad)
+            assert resp.status == 400
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_deadline_sheds_queued_request_504():
+    """A request whose deadline expires while queued for capacity is shed
+    with 504 — before it burns a prefill — instead of waiting out the full
+    queue timeout for a 503."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(reply_delay_s=1.0).start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            gw.state.load_manager.queue_config = QueueConfig(
+                max_active_per_endpoint=1, queue_timeout_s=10.0,
+            )
+            headers = await gw.inference_headers()
+            blocker = asyncio.create_task(
+                gw.client.post(CHAT, json=_chat_body(), headers=headers)
+            )
+            await asyncio.sleep(0.1)  # occupy the single slot
+            t0 = time.monotonic()
+            h2 = dict(headers)
+            h2["X-Request-Deadline-Ms"] = "150"
+            shed = await gw.client.post(CHAT, json=_chat_body(), headers=h2)
+            waited = time.monotonic() - t0
+            assert shed.status == 504
+            assert (await shed.json())["error"]["type"] == "timeout_error"
+            assert waited < 1.0, f"shed took {waited:.2f}s (queue timeout?)"
+            assert (await blocker).status == 200
+            assert gw.state.metrics.summary()["deadline_shed_total"] == 1
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_stalled_reader_write_timeout_frees_stream():
+    """Satellite: a client that stops draining the SSE stream (simulated by
+    the stalled_reader fault inside the pump's guarded write) trips the
+    write timeout — the stream aborts instead of pinning the slot until
+    the inference timeout."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint(tokens_per_reply=8,
+                                        inter_chunk_delay_s=0.02).start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            gw.state.config = dataclasses.replace(
+                gw.state.config, stream_write_timeout_s=0.2,
+            )
+            gw.state.faults = FaultInjector([
+                FaultRule(kind="stalled_reader", latency_ms=5000,
+                          after_bytes=1, max_fires=1),
+            ])
+            headers = await gw.inference_headers()
+            t0 = time.monotonic()
+            resp = await gw.client.post(
+                CHAT, json=_chat_body(stream=True), headers=headers,
+            )
+            assert resp.status == 200
+            raw = await resp.content.read()  # truncated at the stall point
+            elapsed = time.monotonic() - t0
+            assert elapsed < 2.0, f"stall held the stream {elapsed:.1f}s"
+            assert b"[DONE]" not in raw  # aborted, not completed
+            summary = gw.state.metrics.summary()
+            assert summary["stream_write_timeouts_total"] == 1
+            assert summary["faults_injected_total"] == 1
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_goodput_by_priority_and_slo_labels():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockOpenAIEndpoint().start()
+        try:
+            gw.register_mock(mock.url, ["mock-model"])
+            headers = await gw.inference_headers()
+            for prio in ("high", "low", None):
+                body = _chat_body()
+                if prio is not None:
+                    body["priority"] = prio
+                resp = await gw.client.post(CHAT, json=body, headers=headers)
+                assert resp.status == 200
+            summary = gw.state.metrics.summary()
+            by_prio = summary["goodput_by_priority"]
+            assert by_prio.get("high") == 1.0
+            assert by_prio.get("low") == 1.0
+            assert by_prio.get("normal") == 1.0  # unset defaults to normal
+            metrics = await gw.client.get("/metrics")
+            text = await metrics.text()
+            assert 'llmlb_gateway_goodput_by_priority{priority="high"}' in text
+            assert "llmlb_gateway_ratelimit_rejections_total" in text
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())

@@ -35,11 +35,16 @@ class MockOpenAIEndpoint:
     """A fake OpenAI-compatible runtime with configurable behavior."""
 
     def __init__(self, *, model="mock-model", tokens_per_reply=5,
-                 reply_delay_s=0.0, fail_with: int | None = None,
+                 reply_delay_s=0.0, inter_chunk_delay_s=0.0,
+                 fail_with: int | None = None,
                  include_usage=True):
         self.model = model
         self.tokens_per_reply = tokens_per_reply
         self.reply_delay_s = reply_delay_s
+        # stream mode: sleep between SSE chunks so the proxy sees them as
+        # separate reads (a local TestServer otherwise delivers the whole
+        # body in one iter_any chunk)
+        self.inter_chunk_delay_s = inter_chunk_delay_s
         self.fail_with = fail_with
         self.include_usage = include_usage
         self.requests_seen: list[dict] = []
@@ -95,6 +100,8 @@ class MockOpenAIEndpoint:
                 await resp.write(
                     b"data: " + json.dumps(chunk).encode() + b"\n\n"
                 )
+                if self.inter_chunk_delay_s:
+                    await asyncio.sleep(self.inter_chunk_delay_s)
             final = {
                 "id": "chatcmpl-mock", "object": "chat.completion.chunk",
                 "model": body.get("model"),
