@@ -1,0 +1,35 @@
+"""Disaggregated prefill/decode serving (docs/disaggregation.md).
+
+Two composable pieces on top of the existing engine and gateway:
+
+- In-process split (`--role split`, disagg/split.py): one engine process
+  runs a prefill pool and a decode pool as two step loops over one shared
+  PagePool. Handoff is a page-id exchange — the block-table row moves, no
+  KV bytes do — and the adopted request continues exactly like a PR 10
+  parked request resumes, so streams are token-identical to `--role both`.
+
+- Cross-process roles (`--role prefill|decode`, disagg/wire.py +
+  disagg/gateway.py): engines advertise their role through the capability
+  plumbing, the gateway steers prefill-heavy requests to prefill-capable
+  endpoints, and the decode pool adopts the stream via a
+  prompt+committed-tokens replay carried on the handoff wire (the
+  park/resume bit-identity argument makes the replay exact).
+"""
+
+from llmlb_tpu.disagg.wire import (  # noqa: F401
+    HANDOFF_WIRE_VERSION,
+    HandoffError,
+    handoff_payload,
+    parse_handoff,
+)
+
+ROLES = ("both", "split", "prefill", "decode")
+
+
+def normalize_role(role: str | None) -> str:
+    """Resolve a role string ('' / None fall back to 'both'); raises
+    ValueError for anything outside ROLES."""
+    r = (role or "both").strip().lower()
+    if r not in ROLES:
+        raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+    return r

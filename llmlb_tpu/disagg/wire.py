@@ -1,0 +1,110 @@
+"""The cross-process handoff wire: everything a decode engine needs to adopt
+a stream the prefill pool started.
+
+The payload is the disagg counterpart of the multihost plan wire
+(engine/scheduler._plan_wire): `sampling` is `dataclasses.asdict(SamplingParams)`
+on the way out and `SamplingParams(**payload["sampling"])` on the way back, so
+EVERY declared field — priority, deadline_ms, constraint, speculative, seed —
+rides automatically and tests/disagg/test_handoff_wire.py fails the moment a
+new field is declared without surviving the round trip. The gateway relays the
+payload verbatim between engines; it never interprets the sampling block.
+
+Adoption is a prompt+committed-tokens replay: the decode engine chunk-prefills
+`prompt_ids + committed_ids` (the PR 10 park/resume path), which lands every
+token's KV at the exact position the uninterrupted run had it and makes the
+continuation token-identical for greedy and seeded-stochastic sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+HANDOFF_WIRE_VERSION = 1
+
+# Hard cap on wire token counts: the payload crosses process boundaries as
+# JSON, and an absurd length means a corrupted or hostile payload, not a
+# real request (slot capacities are orders of magnitude below this).
+_MAX_WIRE_TOKENS = 4_000_000
+
+
+class HandoffError(ValueError):
+    """Malformed or unsupported handoff payload."""
+
+
+def handoff_payload(
+    prompt_ids: list[int],
+    committed_ids: list[int],
+    sampling,
+    *,
+    stop: list[str] | None = None,
+    request_id: str | None = None,
+) -> dict:
+    """JSON-safe wire form of an in-flight request at its handoff point."""
+    return {
+        "version": HANDOFF_WIRE_VERSION,
+        "request_id": request_id,
+        "prompt_ids": [int(t) for t in prompt_ids],
+        "committed_ids": [int(t) for t in committed_ids],
+        "stop": [str(s) for s in (stop or []) if s],
+        "sampling": dataclasses.asdict(sampling),
+        # emission stamp: the adopting engine reports now - t as the
+        # cross-process handoff latency (same-host clocks; skew caveat in
+        # docs/disaggregation.md)
+        "t": time.time(),
+    }
+
+
+def _token_list(payload: dict, key: str, *, min_len: int = 0) -> list[int]:
+    raw = payload.get(key)
+    if not isinstance(raw, list) or len(raw) < min_len:
+        raise HandoffError(f"'{key}' must be a list of token ids")
+    if len(raw) > _MAX_WIRE_TOKENS:
+        raise HandoffError(f"'{key}' is implausibly long ({len(raw)} tokens)")
+    try:
+        return [int(t) for t in raw]
+    except (TypeError, ValueError):
+        raise HandoffError(f"'{key}' must contain only integers")
+
+
+def parse_handoff(payload: dict):
+    """Validate + rebuild the adoption inputs:
+    (prompt_ids, committed_ids, SamplingParams, stop, request_id, t).
+
+    Raises HandoffError on anything malformed — the decode engine turns
+    that into a 400, never a crashed step loop."""
+    from llmlb_tpu.engine.scheduler import SamplingParams
+
+    if not isinstance(payload, dict):
+        raise HandoffError("handoff payload must be a JSON object")
+    if payload.get("version") != HANDOFF_WIRE_VERSION:
+        raise HandoffError(
+            f"unsupported handoff wire version {payload.get('version')!r} "
+            f"(this engine speaks {HANDOFF_WIRE_VERSION})"
+        )
+    prompt_ids = _token_list(payload, "prompt_ids", min_len=1)
+    committed_ids = _token_list(payload, "committed_ids")
+    raw_sampling = payload.get("sampling")
+    if not isinstance(raw_sampling, dict):
+        raise HandoffError("'sampling' must be an object")
+    known = {f.name for f in dataclasses.fields(SamplingParams)}
+    unknown = set(raw_sampling) - known
+    if unknown:
+        # a NEWER prefill engine added a field this one does not know;
+        # silently dropping it would desync the continuation
+        raise HandoffError(
+            f"unknown sampling fields on the handoff wire: {sorted(unknown)}"
+        )
+    try:
+        sampling = SamplingParams(**raw_sampling)
+    except TypeError as e:
+        raise HandoffError(f"bad sampling block: {e}")
+    stop = payload.get("stop") or []
+    if not isinstance(stop, list) or any(not isinstance(s, str) for s in stop):
+        raise HandoffError("'stop' must be a list of strings")
+    request_id = payload.get("request_id")
+    if request_id is not None and not isinstance(request_id, str):
+        raise HandoffError("'request_id' must be a string")
+    t = payload.get("t")
+    t = float(t) if isinstance(t, (int, float)) else 0.0
+    return prompt_ids, committed_ids, sampling, list(stop), request_id, t

@@ -128,6 +128,17 @@ class EngineMetrics:
         self.preemptions_total = 0
         self.preempt_resumes_total = 0
         self.deadline_shed_total = 0
+        # Disaggregated prefill/decode (docs/disaggregation.md): handoffs by
+        # kind — in_process (split mode's page-id exchange), emitted (this
+        # prefill-role engine handed a stream away), adopted (this
+        # decode-role engine replayed and continued one) — plus the time a
+        # ready request waited between prefill completion and decode
+        # adoption, and the live count of requests stuck in that gap.
+        self.handoff_total: dict[str, int] = {
+            "in_process": 0, "emitted": 0, "adopted": 0,
+        }
+        self.handoff_latency = Histogram(STEP_BUCKETS)
+        self.handoff_backlog = 0
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -259,6 +270,20 @@ class EngineMetrics:
         with self._lock:
             self.deadline_shed_total += 1
 
+    def record_handoff(self, kind: str, latency_s: float | None = None) -> None:
+        """One prefill→decode handoff. `kind` is in_process / emitted /
+        adopted; `latency_s` is the prefill-complete→decode-adoption gap
+        (absent for 'emitted' — the prefill side cannot see adoption)."""
+        with self._lock:
+            if kind in self.handoff_total:
+                self.handoff_total[kind] += 1
+            if latency_s is not None and latency_s >= 0.0:
+                self.handoff_latency.observe(latency_s)
+
+    def set_handoff_backlog(self, n: int) -> None:
+        with self._lock:
+            self.handoff_backlog = n
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -301,6 +326,9 @@ class EngineMetrics:
                 "preemptions_total": self.preemptions_total,
                 "preempt_resumes_total": self.preempt_resumes_total,
                 "deadline_shed_total": self.deadline_shed_total,
+                "handoff_total": dict(self.handoff_total),
+                "handoff_backlog": self.handoff_backlog,
+                "handoff_latency_p50_s": self.handoff_latency.percentile(50),
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
@@ -393,6 +421,16 @@ class EngineMetrics:
                 f"{self.preempt_resumes_total}",
                 "# TYPE llmlb_engine_deadline_shed_total counter",
                 f"llmlb_engine_deadline_shed_total {self.deadline_shed_total}",
+                "# TYPE llmlb_engine_handoff_total counter",
+            ]
+            for kind in ("in_process", "emitted", "adopted"):
+                lines.append(
+                    f'llmlb_engine_handoff_total{{kind="{kind}"}} '
+                    f"{self.handoff_total[kind]}"
+                )
+            lines += [
+                "# TYPE llmlb_engine_handoff_backlog gauge",
+                f"llmlb_engine_handoff_backlog {self.handoff_backlog}",
             ]
             if sched is not None:
                 lines.append(
@@ -405,6 +443,18 @@ class EngineMetrics:
                         f'llmlb_engine_queue_depth_class'
                         f'{{priority="{name}"}} {depth}'
                     )
+                by_role = sched.get("queued_by_role")
+                if by_role:
+                    # split-mode engines only: work waiting for a prefill
+                    # slot vs prefilled work waiting for decode adoption
+                    lines.append(
+                        "# TYPE llmlb_engine_queue_depth_role gauge"
+                    )
+                    for name, depth in sorted(by_role.items()):
+                        lines.append(
+                            f'llmlb_engine_queue_depth_role'
+                            f'{{role="{name}"}} {depth}'
+                        )
             if perf is not None and perf.get("available"):
                 lines += [
                     "# TYPE llmlb_engine_mfu_ratio gauge",
@@ -497,6 +547,8 @@ class EngineMetrics:
                 ("llmlb_engine_prefill_step_seconds", self.prefill_step),
                 ("llmlb_engine_decode_step_seconds", self.decode_step),
                 ("llmlb_engine_schema_compile_seconds", self.schema_compile),
+                ("llmlb_engine_handoff_latency_seconds",
+                 self.handoff_latency),
             ):
                 lines.append(f"# TYPE {name} histogram")
                 cumulative = 0

@@ -17,6 +17,14 @@ Refcount semantics:
 - `unref(page)` drops an owner and returns the page to the free list at zero.
   Unref of an already-free page raises PageError: a double free means two
   owners think they hold the same page and silent reuse would corrupt KV.
+- OWNERSHIP TRANSFER needs no refcount traffic at all: split-mode handoff
+  (llmlb_tpu/disagg/split.py, docs/disaggregation.md) moves a whole
+  block-table row from a prefill slot to a decode slot — the refcount held
+  by "the slot that owns this row" simply changes which slot that is. It is
+  a ref(new)+unref(old) pair collapsed to nothing; the invariant that
+  exactly one live table row references an owned page is what makes the
+  exchange safe, and it is why the donor slot's row must be zeroed in the
+  same step the adopter's row is written.
 
 Page 0 is reserved as the *trash page* (refcount pinned forever): block-table
 entries default to it, so the batched decode step's garbage writes for

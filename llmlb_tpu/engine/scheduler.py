@@ -315,6 +315,13 @@ class _Slot:
     # request is never parked). Preemption needs the committed sequence to
     # rebuild KV via chunk-prefill; bounded by max_tokens per slot.
     out_tokens: list[int] = dataclasses.field(default_factory=list)
+    # Split-mode handoff (llmlb_tpu/disagg/split.py): a prefill-pool slot
+    # whose prompt KV is fully landed and is waiting for a decode slot to
+    # adopt it. `handoff_logits` holds the final prefill dispatch's logits
+    # row ([1, V] device array) so the first token samples at adoption.
+    handoff_ready: bool = False
+    handoff_logits: object | None = None
+    handoff_ready_at: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -353,8 +360,23 @@ class EngineCore:
         spec_ngram: int | None = None,
         quantize: str | None = None,
         prefill_chunk_budget: int | None = None,
+        role: str | None = None,
+        disagg_prefill_slots: int | None = None,
     ):
         self.cfg = cfg
+        # Serving role (docs/disaggregation.md): "both" (default) is the
+        # classic combined loop; "split" runs a prefill pool and a decode
+        # pool as two step loops over one shared PagePool (in-process
+        # disaggregation — built at the end of __init__ once slots exist);
+        # "prefill"/"decode" keep the combined loop and only change what the
+        # server layer advertises and accepts (cross-process roles).
+        from llmlb_tpu.disagg import normalize_role
+
+        if role is None:
+            role = os.environ.get("LLMLB_ROLE")
+        self.role = normalize_role(role)
+        self._disagg_prefill_slots_arg = disagg_prefill_slots
+        self.split = None  # SplitRuntime in split mode
         # Family module (llama / mixtral) supplying the serving fns — one
         # shared contract, so dense and MoE models run the same loop.
         self.family = family_for(cfg)
@@ -784,14 +806,50 @@ class EngineCore:
         self.total_tokens = 0
         self._lock = threading.Lock()
 
+        # Which step loop this thread belongs to ("main" for the combined
+        # loop; split mode tags its two threads "prefill"/"decode" and the
+        # adoption path "handoff") — drives the per-loop prefill-dispatch
+        # ledger below, the tier-1 proof that in split mode ZERO prefill
+        # dispatches ever execute on the decode pool's loop.
+        self._tls = threading.local()
+        self.prefill_dispatch_by_loop: dict[str, int] = {
+            "main": 0, "prefill": 0, "decode": 0, "handoff": 0,
+        }
+        if self.role == "split":
+            from llmlb_tpu.disagg.split import SplitRuntime
+
+            if self.page_pool is None:
+                raise ValueError(
+                    "--role split requires the paged KV layout: the handoff "
+                    "is a block-table page-id exchange"
+                )
+            if self.coordinator is not None:
+                raise ValueError(
+                    "--role split is single-host only (multihost lockstep "
+                    "broadcasts one plan per combined step loop)"
+                )
+            self.split = SplitRuntime(self, self._disagg_prefill_slots_arg)
+
     # ------------------------------------------------------------------ public
+
+    def _loop_tag(self) -> str:
+        return getattr(self._tls, "tag", "main")
+
+    def _note_prefill_dispatch(self) -> None:
+        """Ledger every prefill dispatch by the loop that ran it. Split
+        mode's acceptance invariant — the decode loop NEVER runs prefill —
+        is asserted over this dict in tier-1."""
+        self.prefill_dispatch_by_loop[self._loop_tag()] += 1
 
     def start(self) -> None:
         self._running = True
-        self._thread = threading.Thread(
-            target=self._loop, name="engine-step-loop", daemon=True
-        )
-        self._thread.start()
+        if self.split is not None:
+            self.split.start()
+        else:
+            self._thread = threading.Thread(
+                target=self._loop, name="engine-step-loop", daemon=True
+            )
+            self._thread.start()
         if len(self._window_buckets) > 1:
             # Pre-compile every window-bucket variant off-thread: the first
             # sequence to cross a bucket boundary must not stall every
@@ -865,6 +923,8 @@ class EngineCore:
             self._stop_requested = True
         else:
             self._running = False
+        if self.split is not None:
+            self.split.join(timeout=30)
         if self._thread:
             self._thread.join(timeout=30)
         self._running = False
@@ -1122,7 +1182,11 @@ class EngineCore:
     def _free_slots(self) -> list[int]:
         """Slots available for new requests: unoccupied and not pinned as
         prefix-cache donors (dense mode only — paged donors pin pages, not
-        slots, so pinned_slots() is empty there and every idle slot serves)."""
+        slots, so pinned_slots() is empty there and every idle slot serves).
+        Split mode admits only into the prefill pool (the decode pool fills
+        exclusively through handoff adoption)."""
+        if self.split is not None:
+            return self.split.free_prefill_slots()
         pinned = (self.prefix_cache.pinned_slots()
                   if self.prefix_cache is not None else ())
         return [
@@ -1225,6 +1289,36 @@ class EngineCore:
                                 int(self._seq_lens[i]), i))
         return out
 
+    def _finish_slot(self, slot_id: int, reason: str) -> None:
+        """Terminal teardown of an occupied slot outside the decode-emit
+        path (prefill-time cancellation, split-mode staged drops): terminal
+        event + accounting, cache entry / KV pages / constraint released,
+        and EVERY slot field reset. One copy of the invariant — a new _Slot
+        field (the handoff_* trio being the cautionary tale) has exactly
+        one place to be cleared."""
+        slot = self.slots[slot_id]
+        request = slot.request
+        assert request is not None
+        request.finished_at = time.monotonic()
+        request.events.put(("done", reason))
+        self.metrics.record_request_done(reason)
+        self._cancelled_effective.discard(request.request_id)
+        self._release_cache_entry(slot)
+        self._free_slot_kv(slot_id)
+        self._clear_constraint(slot_id)
+        slot.request = None
+        slot.generated = 0
+        slot.prefilling = False
+        slot.prefill_pos = 0
+        slot.handoff_ready = False
+        slot.handoff_logits = None
+        slot.handoff_ready_at = 0.0
+        slot.last_emit_at = 0.0
+        slot.first_pending = False
+        slot.drafter = None
+        slot.spec_k = 0
+        slot.out_tokens = []
+
     def _park_slot(self, slot_id: int) -> None:
         """Preempt one decoding slot: release its KV (pages back to the pool
         — parking is cheap BECAUSE the layout is paged), capture resume
@@ -1323,13 +1417,38 @@ class EngineCore:
         """Scheduling block for /api/system, /api/health, and /metrics:
         priority-queue depths plus the overload-protection counters."""
         m = self.metrics
-        return {
+        info = {
             "prefill_chunk_budget": self.prefill_chunk_budget,
             "queued_by_class": self.queue_class_depths(),
             "preemptions_total": m.preemptions_total,
             "preempt_resumes_total": m.preempt_resumes_total,
             "deadline_shed_total": m.deadline_shed_total,
         }
+        if self.split is not None:
+            # role-labeled queue depths (docs/disaggregation.md): work still
+            # waiting for a prefill slot vs prefilled work waiting for a
+            # decode slot to adopt it (the handoff backlog)
+            info["queued_by_role"] = {
+                "prefill": sum(info["queued_by_class"].values()),
+                "decode": self.split.backlog(),
+            }
+        return info
+
+    def disagg_info(self) -> dict:
+        """Disaggregation block for /api/system and /api/health: the served
+        role, split-pool sizes, and the handoff counters every consumer of
+        the docs/disaggregation.md surfaces reads."""
+        m = self.metrics
+        info = {
+            "role": self.role,
+            "split": self.split is not None,
+            "handoff_total": dict(m.handoff_total),
+            "handoff_backlog": m.handoff_backlog,
+        }
+        if self.split is not None:
+            info["prefill_slots"] = len(self.split.prefill_pool)
+            info["decode_slots"] = len(self.split.decode_pool)
+        return info
 
     # -------------------------------------------------------------- page pool
 
@@ -1476,10 +1595,13 @@ class EngineCore:
             # PAGE pressure has its own eviction path in _try_reserve_pages.
             if queued > 0 and self._evict_one_prefix():
                 free = self._free_slots()
-        if not free and queued > 0:
+        if not free and queued > 0 and self.split is None:
             # Slot-pressure preemption: a queued request of a MORE important
             # class than some decoding slot parks the least important victim
             # (docs/scheduling.md). Same-class work always waits its turn.
+            # Split mode skips this: parking a decode-pool victim cannot free
+            # a PREFILL slot — its preemption point is handoff adoption
+            # (disagg/split.py acquire_decode_slot) instead.
             head = self._head_priority()
             if head is not None:
                 cands = self._preempt_candidates(head)
@@ -2429,6 +2551,7 @@ class EngineCore:
         slot_ids[g:] = slot_ids[g - 1]
 
         prefill_start = time.monotonic()
+        self._note_prefill_dispatch()
         t_dispatch = time.perf_counter()
         if self.page_pool is not None:
             # padding rows repeat the last real slot's table row, so their
@@ -2474,7 +2597,16 @@ class EngineCore:
         """Batched activation: ONE sample_tokens over the padded logits and
         one vector scatter per device array — ~6 dispatches for the whole
         group instead of ~6 per request. Padding rows repeat the last real
-        row, so their scatters rewrite identical values."""
+        row, so their scatters rewrite identical values.
+
+        Split mode: a prefill-loop activation never lands in the prefill
+        slot — the finished slot is STAGED (prompt KV pinned in its pages,
+        final logits row held) and the handoff pump adopts it into a decode
+        slot, re-entering here under the "handoff" tag."""
+        if self.split is not None and self._loop_tag() == "prefill":
+            self.split.stage_group(group, logits)
+            self.split.pump_handoffs()
+            return
         padded = len(padded_slot_ids)
         temps = np.ones((padded,), np.float32)
         top_ps = np.ones((padded,), np.float32)
@@ -2588,6 +2720,7 @@ class EngineCore:
         ids = np.zeros((1, padded), np.int32)
         ids[0, :n] = self._effective_prompt(request)
         prefill_start = time.monotonic()
+        self._note_prefill_dispatch()
         t_dispatch = time.perf_counter()
         logits, k_all, v_all = self._cp_prefill_fn(
             self.params, jnp.asarray(ids), jnp.asarray([n], np.int32)
@@ -2624,7 +2757,8 @@ class EngineCore:
         """Feed ONE chunk of ONE prefilling slot's prompt into the KV cache.
         Rotates among prefilling slots so a second long prompt shares prefill
         bandwidth instead of waiting head-of-line behind the first."""
-        prefilling = [i for i, s in enumerate(self.slots) if s.prefilling]
+        prefilling = [i for i, s in enumerate(self.slots)
+                      if s.prefilling and not s.handoff_ready]
         if not prefilling:
             return False
         slot_id = prefilling[self._prefill_rr % len(prefilling)]
@@ -2633,19 +2767,7 @@ class EngineCore:
         request = slot.request
         assert request is not None
         if self._is_cancelled(request):
-            request.finished_at = time.monotonic()
-            request.events.put(("done", "cancelled"))
-            self.metrics.record_request_done("cancelled")
-            self._cancelled_effective.discard(request.request_id)
-            self._release_cache_entry(slot)
-            self._free_slot_kv(slot_id)
-            self._clear_constraint(slot_id)
-            slot.request = None
-            slot.prefilling = False
-            slot.generated = 0
-            slot.drafter = None
-            slot.spec_k = 0
-            slot.out_tokens = []
+            self._finish_slot(slot_id, "cancelled")
             return True
 
         prompt = self._effective_prompt(request)
@@ -2669,6 +2791,7 @@ class EngineCore:
         ids[0, :chunk_len] = prompt[start:start + chunk_len]
 
         prefill_start = time.monotonic()
+        self._note_prefill_dispatch()
         t_dispatch = time.perf_counter()
         if self.page_pool is not None:
             logits, self.cache_k, self.cache_v = self.family.prefill_extend_pages(
@@ -2802,9 +2925,12 @@ class EngineCore:
             return fn
 
     def _decode_active(self) -> bool:
+        decode_pool = (self.split.decode_pool if self.split is not None
+                       else range(self.num_slots))
         active = [
-            i for i, s in enumerate(self.slots)
-            if s.request is not None and not s.prefilling
+            i for i in decode_pool
+            if self.slots[i].request is not None
+            and not self.slots[i].prefilling
         ]
         if not active:
             # The occupancy gauge is otherwise only written on decode steps
@@ -3099,6 +3225,9 @@ class EngineCore:
             self._clear_constraint(slot_id)
             slot.prefilling = False
             slot.prefill_pos = 0
+            slot.handoff_ready = False
+            slot.handoff_logits = None
+            slot.handoff_ready_at = 0.0
             slot.generated = 0
             slot.last_emit_at = 0.0
             slot.first_pending = False

@@ -22,6 +22,7 @@ import uuid
 from aiohttp import web
 
 from llmlb_tpu import __version__
+from llmlb_tpu.disagg import HandoffError, handoff_payload, parse_handoff
 from llmlb_tpu.engine.profiling import ProfileError, ProfileManager
 from llmlb_tpu.engine.scheduler import SamplingParams
 from llmlb_tpu.engine.service import Engine, EngineError
@@ -157,6 +158,27 @@ def _speculative_from(body: dict) -> dict | None:
     return out or None
 
 
+def _handoff_tokens_from(body: dict) -> int:
+    """Tokens the prefill side commits before handing off (the committed
+    window the decode engine replays). Per-request `handoff_tokens`
+    overrides LLMLB_DISAGG_HANDOFF_TOKENS (default 1 — prefill + first
+    token, the smallest window that proves the stream is live). Clamped to
+    64: the window rides the wire and is replayed by the adopter, so an
+    absurd value just moves decode work back onto the prefill pool."""
+    import os
+
+    raw = body.get("handoff_tokens")
+    if raw is None:
+        raw = os.environ.get("LLMLB_DISAGG_HANDOFF_TOKENS", 1)
+    try:
+        k = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError("'handoff_tokens' must be an integer")
+    if isinstance(body.get("handoff_tokens"), bool) or not 1 <= k <= 64:
+        raise ValueError("'handoff_tokens' must be between 1 and 64")
+    return k
+
+
 def _stops_from(body: dict) -> list[str]:
     stop = body.get("stop") or body.get("stop_sequences") or []
     if isinstance(stop, str):
@@ -199,6 +221,16 @@ class EngineAPI:
         caps = ["chat_completion", "structured_outputs"]
         if self.engine.supports_embeddings():
             caps.append("embeddings")
+        # Disaggregation roles ride the capability list (the structured-
+        # outputs advertisement is the template): the gateway's role-aware
+        # balancer steers prefill-heavy requests toward "prefill"-capable
+        # endpoints and handoff adoption toward "decode"-capable ones
+        # (docs/disaggregation.md).
+        role = self.engine.core.role
+        if role in ("both", "split", "prefill"):
+            caps.append("prefill")
+        if role in ("both", "split", "decode"):
+            caps.append("decode")
 
         def entry(model_id: str, caps: list[str]) -> dict:
             return {
@@ -211,7 +243,9 @@ class EngineAPI:
                 "capabilities": caps,
             }
 
-        data = [entry(self.engine.model_id, caps)]
+        main_entry = entry(self.engine.model_id, caps)
+        main_entry["role"] = role
+        data = [main_entry]
         if self.asr is not None:
             data.append(entry(self.asr.model_id, ["audio_transcription"]))
         if self.tts is not None:
@@ -370,6 +404,8 @@ class EngineAPI:
                 "spec": self.engine.core.spec_info(),
                 # overload protection: priority queues, preemption counters
                 "sched": self.engine.core.sched_info(),
+                # disaggregated prefill/decode: role + handoff counters
+                "disagg": self.engine.core.disagg_info(),
                 # live roofline: MFU / HBM-bandwidth utilization against the
                 # chip's peak specs (available only on chips in the table
                 # and once decode traffic has flowed)
@@ -513,37 +549,47 @@ class EngineAPI:
 
     # ------------------------------------------------------ chat completions
 
+    def _parse_chat(self, request: web.Request, body: dict):
+        """Shared chat-request parse (chat_completions + the handoff-prefill
+        endpoint, which accepts the same body): returns (prompt_ids,
+        sampling, stops, tool_name, model). Raises ValueError for anything
+        malformed — callers turn that into a 400 naming the field."""
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("'messages' must be a non-empty array")
+        if int(body.get("n") or 1) != 1:
+            raise ValueError("only n=1 is supported")
+        model = body.get("model") or self.engine.model_id
+        try:
+            prompt_ids = self.engine.encode_chat(messages)
+        except ValueError:
+            raise
+        except Exception as e:
+            raise ValueError(f"failed to encode messages: {e}")
+        # Structured outputs: response_format (json_object / json_schema) or
+        # a forced tool_choice compile to a grammar constraint the scheduler
+        # enforces token by token. Malformed or uncompilable requests 400
+        # here with the offending feature named.
+        structured = inspect_request(body)
+        sampling = _sampling_from(body)
+        sampling.seed = parse_seed(body)
+        sampling.deadline_ms = _deadline_from(request)
+        if structured is not None:
+            sampling.constraint = structured.spec
+        tool_name = structured.tool_name if structured is not None else None
+        return prompt_ids, sampling, _stops_from(body), tool_name, model
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
-        messages = body.get("messages")
-        if not isinstance(messages, list) or not messages:
-            return _error(400, "'messages' must be a non-empty array")
-        if int(body.get("n") or 1) != 1:
-            return _error(400, "only n=1 is supported")
-        model = body.get("model") or self.engine.model_id
-
         try:
-            prompt_ids = self.engine.encode_chat(messages)
-        except Exception as e:
-            return _error(400, f"failed to encode messages: {e}")
-        # Structured outputs: response_format (json_object / json_schema) or
-        # a forced tool_choice compile to a grammar constraint the scheduler
-        # enforces token by token. Malformed or uncompilable requests 400
-        # here with the offending feature named.
-        try:
-            structured = inspect_request(body)
-            sampling = _sampling_from(body)
-            sampling.seed = parse_seed(body)
-            sampling.deadline_ms = _deadline_from(request)
+            prompt_ids, sampling, stops, tool_name, model = self._parse_chat(
+                request, body
+            )
         except ValueError as e:
             return _error(400, str(e))
-        if structured is not None:
-            sampling.constraint = structured.spec
-        tool_name = structured.tool_name if structured is not None else None
-        stops = _stops_from(body)
 
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -566,46 +612,13 @@ class EngineAPI:
             return _error(500, str(e), "server_error")
         except ValueError as e:
             return _error(400, str(e))
-        if tool_name is not None:
-            # Forced tool call: the constrained output IS the arguments
-            # object; grammar acceptance maps to finish_reason "tool_calls".
-            message: dict = {
-                "role": "assistant",
-                "content": None,
-                "tool_calls": [{
-                    "id": f"call_{uuid.uuid4().hex[:24]}",
-                    "type": "function",
-                    "function": {"name": tool_name, "arguments": result.text},
-                }],
-            }
-            finish = ("tool_calls" if result.finish_reason == "stop"
-                      else result.finish_reason)
-        else:
-            message = {"role": "assistant", "content": result.text}
-            finish = result.finish_reason
-        return web.json_response(
-            {
-                "id": completion_id,
-                "object": "chat.completion",
-                "created": created,
-                "model": model,
-                "system_fingerprint": SYSTEM_FINGERPRINT,
-                "choices": [
-                    {
-                        "index": 0,
-                        "message": message,
-                        "finish_reason": finish,
-                    }
-                ],
-                "usage": _usage(result.prompt_tokens, result.completion_tokens),
-            },
-            headers=_rid_headers(rid),
-        )
+        return self._chat_response(completion_id, created, model, result,
+                                   tool_name, rid)
 
     async def _stream_chat(
         self, request, completion_id, created, model, prompt_ids, sampling, stops,
         include_usage: bool, request_id: str | None = None,
-        tool_name: str | None = None,
+        tool_name: str | None = None, agen=None,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -644,9 +657,11 @@ class EngineAPI:
             }]}))
         usage = _usage(len(prompt_ids), 0)
         finish = "stop"
+        if agen is None:
+            agen = self.engine.stream(prompt_ids, sampling, stops,
+                                      request_id=request_id)
         try:
-            async for delta in self.engine.stream(prompt_ids, sampling, stops,
-                                                  request_id=request_id):
+            async for delta in agen:
                 if delta.text:
                     if tool_name is not None:
                         await _sse_send(resp, chunk({"tool_calls": [{
@@ -672,6 +687,164 @@ class EngineAPI:
             await _sse_send(resp, final)
         await resp.write(b"data: [DONE]\n\n")
         return resp
+
+    # -------------------------------------------- disaggregated handoff wire
+
+    def _chat_response(self, completion_id: str, created: int, model: str,
+                       result, tool_name: str | None,
+                       rid: str | None) -> web.Response:
+        """Non-streaming chat.completion JSON from a collected result —
+        shared by /v1/chat/completions and the handoff surfaces."""
+        if tool_name is not None:
+            message: dict = {
+                "role": "assistant",
+                "content": None,
+                "tool_calls": [{
+                    "id": f"call_{uuid.uuid4().hex[:24]}",
+                    "type": "function",
+                    "function": {"name": tool_name, "arguments": result.text},
+                }],
+            }
+            finish = ("tool_calls" if result.finish_reason == "stop"
+                      else result.finish_reason)
+        else:
+            message = {"role": "assistant", "content": result.text}
+            finish = result.finish_reason
+        return web.json_response(
+            {
+                "id": completion_id,
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "system_fingerprint": SYSTEM_FINGERPRINT,
+                "choices": [
+                    {"index": 0, "message": message, "finish_reason": finish}
+                ],
+                "usage": _usage(result.prompt_tokens,
+                                result.completion_tokens),
+            },
+            headers=_rid_headers(rid),
+        )
+
+    async def handoff_prefill(self, request: web.Request) -> web.Response:
+        """POST /v1/handoff/prefill — the prefill-role half of the
+        cross-process handoff (docs/disaggregation.md). Body: a standard
+        chat-completions request plus optional `handoff_tokens` (how many
+        tokens to commit before handing off; default LLMLB_DISAGG_HANDOFF_TOKENS
+        or 1). Responds `{"object": "llmlb.handoff", "handoff": <wire
+        payload>, "finish": str|null, ...}` — the caller POSTs the payload
+        to a decode-capable engine's /v1/handoff, which streams the FULL
+        completion (committed + continuation). `finish` is null while the
+        stream has more to generate; when the request completed inside the
+        committed window (EOS / max_tokens) it carries the natural finish —
+        the adopt replay still reproduces that finish token-identically
+        (EOS re-samples at the same absolute position; a spent max_tokens
+        budget finishes at adoption without touching the step loop), so
+        orchestrators need only one shape."""
+        if self.engine.core.role == "decode":
+            return _error(
+                409, "this engine serves --role decode; it adopts handoffs "
+                "(/v1/handoff) but does not originate them",
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        try:
+            prompt_ids, sampling, stops, tool_name, model = self._parse_chat(
+                request, body
+            )
+            emit = _handoff_tokens_from(body)
+        except ValueError as e:
+            return _error(400, str(e))
+        rid = _request_id_from(request)
+        try:
+            committed, finish = await self.engine.prefill_handoff(
+                prompt_ids, sampling, emit_tokens=emit, request_id=rid
+            )
+        except EngineError as e:
+            return _error(500, str(e), "server_error")
+        except ValueError as e:
+            return _error(400, str(e))
+        payload = handoff_payload(
+            prompt_ids, committed, sampling, stop=stops, request_id=rid
+        )
+        return web.json_response(
+            {
+                "object": "llmlb.handoff",
+                "model": model,
+                "handoff": payload,
+                "finish": finish,
+                "tool_name": tool_name,
+                "usage": _usage(len(prompt_ids), len(committed)),
+            },
+            headers=_rid_headers(rid),
+        )
+
+    async def handoff_adopt(self, request: web.Request) -> web.StreamResponse:
+        """POST /v1/handoff — adopt a stream a prefill engine started. Body:
+        `{"handoff": <wire payload>, "stream": bool, "model": str?,
+        "tool_name": str?}`. The payload replays as prompt+committed chunk
+        prefill (PR 10 park/resume), so the continuation is token-identical
+        to an uninterrupted run; the response carries the FULL text
+        (committed + continuation) as a normal chat completion / SSE stream.
+        Malformed payloads 400 via HandoffError — never a crashed step loop.
+        """
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        try:
+            prompt_ids, committed, sampling, stops, wire_rid, t0 = (
+                parse_handoff(body.get("handoff"))
+            )
+        except HandoffError as e:
+            return _error(400, str(e))
+        tool_name = body.get("tool_name")
+        if tool_name is not None and not isinstance(tool_name, str):
+            return _error(400, "'tool_name' must be a string")
+        model = body.get("model") or self.engine.model_id
+        rid = _request_id_from(request) or wire_rid
+        try:
+            # the gateway recomputes the REMAINING deadline budget onto the
+            # header; it overrides the wire's original (now partly spent) one
+            header_deadline = _deadline_from(request)
+        except ValueError as e:
+            return _error(400, str(e))
+        if header_deadline is not None:
+            sampling.deadline_ms = header_deadline
+        agen = self.engine.adopt_stream(
+            prompt_ids, committed, sampling, stops,
+            request_id=rid, emitted_at=t0,
+        )
+        completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        if body.get("stream"):
+            return await self._stream_chat(
+                request, completion_id, created, model,
+                prompt_ids, sampling, stops,
+                include_usage=True, request_id=rid, tool_name=tool_name,
+                agen=agen,
+            )
+        text = []
+        final = None
+        try:
+            async for delta in agen:
+                text.append(delta.text)
+                if delta.finish_reason is not None:
+                    final = delta
+        except EngineError as e:
+            return _error(500, str(e), "server_error")
+        except ValueError as e:
+            return _error(400, str(e))
+        assert final is not None
+        import dataclasses as _dc
+
+        result = _dc.replace(final, text="".join(text))
+        return self._chat_response(completion_id, created, model, result,
+                                   tool_name, rid)
 
     # ----------------------------------------------------------- completions
 
@@ -911,6 +1084,8 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     api = EngineAPI(engine, asr=asr, tts=tts, image=image)
     app.router.add_get("/v1/models", api.list_models)
     app.router.add_post("/v1/chat/completions", api.chat_completions)
+    app.router.add_post("/v1/handoff", api.handoff_adopt)
+    app.router.add_post("/v1/handoff/prefill", api.handoff_prefill)
     app.router.add_post("/v1/completions", api.completions)
     app.router.add_post("/v1/responses", api.responses)
     app.router.add_post("/v1/embeddings", api.embeddings)
@@ -1020,6 +1195,22 @@ def main(argv: list[str] | None = None) -> None:
              "3; also via LLMLB_SPEC_NGRAM)",
     )
     parser.add_argument(
+        "--role", choices=("both", "split", "prefill", "decode"),
+        default=None,
+        help="serving role (default both; also via LLMLB_ROLE): 'split' "
+             "runs an in-process prefill pool + decode pool over one paged "
+             "KV pool with page-id handoff; 'prefill'/'decode' advertise a "
+             "cross-process role to the gateway, which steers prefill-heavy "
+             "requests to prefill engines and hands the stream to a decode "
+             "engine over the /v1/handoff wire (docs/disaggregation.md)",
+    )
+    parser.add_argument(
+        "--disagg-prefill-slots", type=int, default=None,
+        help="slots in the prefill pool under --role split (default "
+             "num_slots // 4, min 1; also via LLMLB_DISAGG_PREFILL_SLOTS); "
+             "the remaining slots form the decode pool",
+    )
+    parser.add_argument(
         "--prefix-cache", choices=("on", "off"), default=None,
         help="radix-tree prefix KV reuse across requests (default on; "
              "also via LLMLB_PREFIX_CACHE=0)",
@@ -1074,6 +1265,10 @@ def main(argv: list[str] | None = None) -> None:
         extra["spec_max_draft"] = max(1, args.spec_max_draft)
     if args.spec_ngram is not None:
         extra["spec_ngram"] = max(1, args.spec_ngram)
+    if args.role is not None:
+        extra["role"] = args.role
+    if args.disagg_prefill_slots is not None:
+        extra["disagg_prefill_slots"] = max(1, args.disagg_prefill_slots)
     if args.prefix_cache is not None:
         extra["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_slots is not None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import AsyncIterator
@@ -227,6 +228,218 @@ class Engine:
             if not finished:
                 request.cancel()
 
+    # -------------------------------------------------- cross-process handoff
+
+    async def prefill_handoff(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        emit_tokens: int = 1,
+        request_id: str | None = None,
+    ) -> tuple[list[int], str | None]:
+        """Prefill-role side of the cross-process handoff
+        (docs/disaggregation.md): run admission + prefill and commit the
+        first `emit_tokens` tokens, then stop. Returns ``(committed_ids,
+        finish_reason)`` — finish_reason is None when the request has more
+        to generate (the handoff case: the caller wraps the committed ids
+        in a wire payload for a decode engine to adopt), or the natural
+        finish ("stop"/"length") when the request completed inside the
+        committed window and no handoff is needed.
+
+        Token-level on purpose: the committed ids ride the wire verbatim and
+        the ADOPTING engine owns detokenization and stop sequences, so its
+        incremental detokenizer sees the exact same token sequence an
+        uninterrupted run would have."""
+        k = max(1, int(emit_tokens))
+        bounded = dataclasses.replace(
+            sampling, max_tokens=min(sampling.max_tokens, k)
+        )
+        request = Request(
+            prompt_ids=prompt_ids, sampling=bounded,
+            request_id=(f"{request_id}.{uuid.uuid4().hex[:8]}"
+                        if request_id else uuid.uuid4().hex),
+        )
+        loop = asyncio.get_running_loop()
+        if sampling.constraint is not None:
+            request.compiled_constraint = await loop.run_in_executor(
+                self._executor,
+                self.constraint_compiler.compile_spec,
+                sampling.constraint,
+            )
+        self.core.submit(request)
+        committed: list[int] = []
+        finish: str | None = None
+        try:
+            while True:
+                kind, value = await loop.run_in_executor(
+                    self._executor, request.events.get
+                )
+                if kind == "error":
+                    raise EngineError(str(value))
+                if kind == "token":
+                    committed.append(int(value))
+                else:  # done
+                    finish = str(value)
+                    break
+        finally:
+            if finish is None:
+                request.cancel()
+        if (finish == "length" and len(committed) >= k
+                and sampling.max_tokens > k):
+            # the bounded run was cut at the emit budget, not a real finish:
+            # this stream continues on whichever engine adopts it
+            self.core.metrics.record_handoff("emitted")
+            return committed, None
+        return committed, finish
+
+    async def adopt_stream(
+        self,
+        prompt_ids: list[int],
+        committed_ids: list[int],
+        sampling: SamplingParams,
+        stop: list[str] | None = None,
+        request_id: str | None = None,
+        emitted_at: float = 0.0,
+    ) -> AsyncIterator[StreamDelta]:
+        """Decode-pool side of the cross-process handoff: adopt a stream a
+        prefill engine started, by replaying prompt + committed tokens as a
+        chunk-prefill (the PR 10 park/resume path — KV lands at identical
+        absolute positions, so greedy and seeded-stochastic continuations
+        are token-identical to an uninterrupted run) and then decoding the
+        remainder here.
+
+        The full text (committed + continuation) is emitted: the prefill
+        side never detokenized, so this engine's incremental detokenizer
+        and stop-sequence scan see the stream exactly as `--role both`
+        would have."""
+        from llmlb_tpu.engine.scheduler import ParkedState
+        from llmlb_tpu.structured import ConstraintState
+
+        loop = asyncio.get_running_loop()
+        compiled = None
+        cursor = None
+        if sampling.constraint is not None:
+            compiled = await loop.run_in_executor(
+                self._executor,
+                self.constraint_compiler.compile_spec,
+                sampling.constraint,
+            )
+            # Rebuild the grammar cursor at its handoff position: the FSM
+            # re-walks the committed tokens (a fresh start-state cursor
+            # would mask the continuation as if at the string beginning —
+            # the PR 10 park bug, cross-process edition).
+            cursor = ConstraintState(compiled)
+            for t in committed_ids:
+                cursor.advance(int(t))
+        drafter = None
+        spec_k = 0
+        core = self.core
+        if core._spec_available:
+            knobs = sampling.speculative
+            knobs = knobs if isinstance(knobs, dict) else {}
+            if bool(knobs.get("enabled", core.spec.enabled)):
+                from llmlb_tpu.spec import PromptLookupDrafter
+
+                try:
+                    want = int(knobs.get("max_draft_tokens")
+                               or core.spec.max_draft_tokens)
+                except (TypeError, ValueError):
+                    want = core.spec.max_draft_tokens
+                spec_k = max(1, min(want, core.spec.max_draft_tokens))
+                # index prompt + committed: exactly the state the prefill
+                # engine's drafter held at the handoff point
+                drafter = PromptLookupDrafter(
+                    prompt_ids, max_ngram=core.spec.max_ngram,
+                    min_ngram=core.spec.min_ngram,
+                )
+                for t in committed_ids:
+                    drafter.append(int(t))
+
+        detok = IncrementalDetokenizer(self.tokenizer)
+        stop = [s for s in (stop or []) if s]
+        holdback = max((len(s) for s in stop), default=1) - 1
+        acc = "".join(detok.push(int(t)) for t in committed_ids)
+        emitted = 0
+        completion_tokens = len(committed_ids)
+        ttft: float | None = None
+        finished = False
+
+        def final(text: str, reason: str) -> StreamDelta:
+            return StreamDelta(
+                text=text, finish_reason=reason,
+                prompt_tokens=len(prompt_ids),
+                completion_tokens=completion_tokens,
+                ttft_s=ttft,
+            )
+
+        # the wire stamp is time.time() (wall clock — the only clock two
+        # processes share; same-host skew caveat in docs/disaggregation.md),
+        # so the latency diff must stay in the same clock domain
+        latency = max(0.0, time.time() - emitted_at) if emitted_at else None
+        core.metrics.record_handoff("adopted", latency)
+
+        # A handoff that is already terminal (stop string inside the
+        # committed text, or a payload whose committed run used up the
+        # whole budget) finishes here without touching the step loop.
+        hit = _find_stop(acc, stop)
+        if hit is not None:
+            # truncation lands at `hit`, before anything flush could append
+            yield final(acc[:hit], "stop")
+            return
+        if completion_tokens >= sampling.max_tokens:
+            # terminal without further pushes: drain the detokenizer's
+            # held-back bytes exactly like the stream path does on "done"
+            acc += detok.flush()
+            yield final(acc, "length")
+            return
+
+        request = Request(
+            prompt_ids=list(prompt_ids), sampling=sampling,
+            request_id=(f"{request_id}.{uuid.uuid4().hex[:8]}"
+                        if request_id else uuid.uuid4().hex),
+            compiled_constraint=compiled,
+            parked=ParkedState(
+                generated=len(committed_ids), tokens=list(committed_ids),
+                constraint=cursor, drafter=drafter, spec_k=spec_k,
+            ),
+        )
+        core.submit(request)
+        try:
+            while True:
+                kind, value = await loop.run_in_executor(
+                    self._executor, request.events.get
+                )
+                if kind == "error":
+                    raise EngineError(str(value))
+                if kind == "token":
+                    completion_tokens += 1
+                    if ttft is None and request.first_token_at:
+                        ttft = (request.first_token_at
+                                - request.submitted_at)
+                    acc += detok.push(int(value))
+                else:  # done
+                    acc += detok.flush()
+
+                hit = _find_stop(acc, stop)
+                if hit is not None:
+                    finished = True
+                    request.cancel()
+                    yield final(acc[emitted:hit], "stop")
+                    return
+                if kind == "done":
+                    finished = True
+                    yield final(acc[emitted:], str(value))
+                    return
+                boundary = max(emitted, len(acc) - holdback)
+                if boundary > emitted:
+                    delta = StreamDelta(text=acc[emitted:boundary],
+                                        ttft_s=ttft)
+                    emitted = boundary
+                    yield delta
+        finally:
+            if not finished:
+                request.cancel()
+
     async def complete(
         self,
         prompt_ids: list[int],
@@ -353,6 +566,11 @@ class Engine:
             # overload protection: priority-queue depths, preemption and
             # deadline-shed counters (docs/scheduling.md)
             "sched": self.core.sched_info(),
+            # disaggregated prefill/decode: served role, split-pool sizes,
+            # handoff counters (docs/disaggregation.md) — the gateway's
+            # health probe re-reads `role` from here every interval, so a
+            # restarted engine that changed role re-routes within one probe
+            "disagg": self.core.disagg_info(),
             # live roofline (MFU / HBM-BW vs chip peaks, docs/profiling.md);
             # the gateway's telemetry-aware placement can read how close to
             # the hardware each engine is running

@@ -48,6 +48,9 @@ def endpoint_to_json(ep: Endpoint, models: list | None = None) -> dict:
         "endpoint_type": ep.endpoint_type.value,
         "status": ep.status.value,
         "breaker_state": ep.breaker_state,
+        # disaggregation role as of the last health probe ("both" when the
+        # endpoint advertises none — docs/disaggregation.md)
+        "role": ep.accelerator.role or "both",
         "latency_ms": ep.latency_ms,
         "consecutive_failures": ep.consecutive_failures,
         "accelerator": {

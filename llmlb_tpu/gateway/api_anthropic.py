@@ -22,9 +22,11 @@ import aiohttp
 from aiohttp import web
 
 from llmlb_tpu.gateway.api_openai import (
+    HandoffOrchestrationError,
     QueueTimeout,
     StreamWriteTimeout,
     _chat_prompt_text,
+    _handoff_upstream,
     _record,
     affinity_text_from_body,
     deadline_at_of,
@@ -449,6 +451,16 @@ async def messages(request: web.Request) -> web.StreamResponse:
     wfq_weight = state.admission.weight_for(tenant_name)
     prio = priority_label(body)
 
+    # Disaggregation role steering — same policy as proxy_openai_post
+    # (docs/disaggregation.md): long cold-prefix prompts prefer
+    # prefill-capable endpoints, everything else avoids prefill-only ones.
+    from llmlb_tpu.disagg.gateway import endpoint_role, is_prefill_heavy
+
+    prefill_heavy = is_prefill_heavy(
+        state, canonical,
+        estimate_tokens(_chat_prompt_text(openai_body)), prefix_hash,
+    )
+
     # Same failover loop as proxy_openai_post: re-select excluding failed
     # endpoints, retry under the attempt cap + global budget; streams fail
     # over only before the first Anthropic event reaches the client.
@@ -478,6 +490,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
                 trace=trace, prefix_hash=prefix_hash, exclude=fo.failed_ids,
                 queue_timeout_s=queue_timeout,
                 tenant=tenant, weight=wfq_weight,
+                prefill_heavy=prefill_heavy,
             )
         except QueueTimeout:
             if deadline_at is not None and time.monotonic() >= deadline_at:
@@ -496,7 +509,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
             return _anthropic_error(
                 404, f"model {model!r} is not available", "not_found_error"
             )
-        endpoint, engine_model, lease = selection
+        endpoint, engine_model, lease, chosen_model = selection
         openai_body["model"] = engine_model
 
         headers = {"Content-Type": "application/json"}
@@ -518,13 +531,35 @@ async def messages(request: web.Request) -> web.StreamResponse:
         if trace is not None:
             trace.begin("proxy")
         try:
-            upstream = await upstream_post(
-                state, endpoint, "/v1/chat/completions",
-                json=openai_body,
-                headers=headers,
-                timeout=aiohttp.ClientTimeout(
-                    total=state.config.inference_timeout_s
-                ),
+            if endpoint_role(endpoint, chosen_model) == "prefill":
+                # two-phase disaggregated handoff: prefill here, adopt on a
+                # decode-capable endpoint; the returned upstream is a normal
+                # chat-completions response/SSE, so the Anthropic transform
+                # below consumes it unchanged (docs/disaggregation.md)
+                upstream, endpoint, lease, _adopt_model = (
+                    await _handoff_upstream(
+                        state, fo, endpoint, lease, canonical, capability,
+                        TpsApiKind.CHAT, openai_body, headers, deadline_at,
+                        is_stream, engine_model,
+                    )
+                )
+            else:
+                upstream = await upstream_post(
+                    state, endpoint, "/v1/chat/completions",
+                    json=openai_body,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=state.config.inference_timeout_s
+                    ),
+                )
+        except HandoffOrchestrationError as e:
+            fo.record_failure(e.endpoint, e.lease, e.reason)
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry(e.reason):
+                continue
+            return _anthropic_error(
+                502, f"handoff adoption failed: {e.reason}", "api_error"
             )
         except RETRYABLE_EXCEPTIONS as e:
             reason = ("timeout" if isinstance(e, asyncio.TimeoutError)

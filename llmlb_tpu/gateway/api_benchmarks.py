@@ -55,7 +55,7 @@ async def _run_single(state, model: str, prompt: str, max_tokens: int) -> dict:
         selection = None
     if selection is None:
         return {"ok": False, "error": "no endpoint", "endpoint_id": None}
-    endpoint, engine_model, lease = selection
+    endpoint, engine_model, lease, _model_rec = selection
     # Benchmarks go through the real admission machinery, so on a half-open
     # breaker they consume the probe slot — every exit below must report an
     # outcome to the resilience manager or that slot would stay wedged.

@@ -218,7 +218,8 @@ async def select_endpoint_with_queue(
     trace=None, prefix_hash: str | None = None,
     exclude: set[str] | None = None, queue_timeout_s: float | None = None,
     tenant: str | None = None, weight: float = 1.0,
-) -> tuple[Endpoint, str, "RequestLease"] | None:
+    prefill_heavy: bool | None = None,
+) -> "tuple[Endpoint, str, RequestLease, object] | None":
     """Atomically TPS-select and lease an endpoint serving the model; if all
     are at the admission cap, park on the AdmissionQueue until a lease release
     wakes us or the queue timeout passes (notify-based, no polling — parity:
@@ -232,15 +233,30 @@ async def select_endpoint_with_queue(
     itself. Both reduce the candidate set, never the 404 decision: a model
     whose endpoints are all excluded or breaker-open queues (and eventually
     503s with queue semantics), it does not 404. `queue_timeout_s` overrides
-    the configured queue timeout (failover re-selection uses a short one)."""
+    the configured queue timeout (failover re-selection uses a short one).
+
+    `prefill_heavy` engages disaggregation role steering
+    (docs/disaggregation.md): True prefers prefill-capable endpoints, False
+    prefers non-prefill-only ones, None (non-generation traffic) skips role
+    filtering. The filter is soft — it falls back to the full candidate set
+    rather than making a servable model unroutable — and prefix affinity
+    composes with it (the hash steers within the filtered list)."""
+    from llmlb_tpu.disagg.gateway import role_filter
+
     if not state.registry.find_by_model(model, capability):
         return None
 
     def get_endpoints() -> list[Endpoint]:
-        return [
-            ep for ep, _ in state.registry.find_by_model(model, capability)
+        pairs = [
+            (ep, m) for ep, m in state.registry.find_by_model(model,
+                                                             capability)
             if not exclude or ep.id not in exclude
         ]
+        eps = [ep for ep, _ in pairs]
+        if prefill_heavy is not None:
+            eps = role_filter(eps, prefill_heavy=prefill_heavy,
+                              models=[m for _, m in pairs])
+        return eps
 
     if trace is not None:
         trace.begin("admission")
@@ -266,11 +282,14 @@ async def select_endpoint_with_queue(
         trace.mark("endpoint_select", endpoint=result.endpoint.name)
         trace.set_endpoint(result.endpoint)
     pairs = state.registry.find_by_model(model, capability)
-    engine_model = next(
-        (m.model_id for ep, m in pairs if ep.id == result.endpoint.id),
-        model,
+    model_rec = next(
+        (m for ep, m in pairs if ep.id == result.endpoint.id), None,
     )
-    return result.endpoint, engine_model, result.lease
+    engine_model = model_rec.model_id if model_rec is not None else model
+    # model_rec rides along so callers can read the endpoint's capability
+    # advertisement (disagg role fallback) without re-scanning the registry
+    # on every attempt
+    return result.endpoint, engine_model, result.lease, model_rec
 
 
 class QueueTimeout(Exception):
@@ -279,6 +298,135 @@ class QueueTimeout(Exception):
                          f"after {waited_s:.1f}s")
         self.queue_position = queue_position
         self.waited_s = waited_s
+
+
+class HandoffOrchestrationError(Exception):
+    """Phase-2 (adoption) failure of a two-phase disaggregated handoff:
+    carries WHICH endpoint failed (the adopter — its lease has already been
+    failed) so the retry loop can book the failure there instead of against
+    the prefill endpoint that did its half of the work."""
+
+    def __init__(self, endpoint: Endpoint, lease, reason: str):
+        super().__init__(reason)
+        self.endpoint = endpoint
+        self.lease = lease
+        self.reason = reason
+
+
+async def _handoff_upstream(
+    state: AppState, fo: "FailoverController", endpoint: Endpoint, lease,
+    model: str, capability: Capability, api_kind: TpsApiKind,
+    payload: dict, headers: dict, deadline_at: float | None, is_stream: bool,
+    engine_model: str,
+):
+    """The two-phase disaggregated handoff (docs/disaggregation.md):
+
+    1. POST the chat body to the prefill-only endpoint's /v1/handoff/prefill
+       — it admits, prefills, commits the first token(s), and answers with
+       the wire payload (prompt + committed ids + full sampling block).
+    2. POST the payload to a decode-capable adopter's /v1/handoff — it
+       replays prompt+committed (the PR 10 park/resume path, so the
+       continuation is token-identical) and streams the FULL completion in
+       the normal chat-completions shape.
+
+    Returns ``(upstream_response, serving_endpoint, serving_lease,
+    engine_model)`` — the caller's existing status/stream/usage handling
+    applies unchanged, now accounting against the adopter. Phase-1 failures
+    surface exactly like a normal upstream failure on the prefill endpoint
+    (non-200 responses are returned as-is; transport errors propagate).
+    Phase-2 failures raise HandoffOrchestrationError with the adopter's
+    identity. When no decode-capable endpoint has a free slot the prefill
+    endpoint adopts its own payload — it keeps a combined step loop under
+    ``--role prefill``, so the request never strands."""
+    timeout = aiohttp.ClientTimeout(
+        total=state.config.inference_timeout_s, sock_connect=10
+    )
+    resp1 = await upstream_post(
+        state, endpoint, "/v1/handoff/prefill",
+        json=payload, headers=headers, timeout=timeout,
+    )
+    if resp1.status != 200:
+        return resp1, endpoint, lease, engine_model
+    try:
+        body1 = await resp1.json(content_type=None)
+    except RETRYABLE_EXCEPTIONS + (ValueError,):
+        raise aiohttp.ClientPayloadError(
+            "handoff prefill response was not JSON"
+        )
+    finally:
+        resp1.release()
+    if not isinstance(body1, dict) or body1.get("object") != "llmlb.handoff":
+        raise aiohttp.ClientPayloadError(
+            "handoff prefill returned an unexpected shape"
+        )
+
+    # the prefill endpoint's half is done and successful: settle its lease
+    # with the committed-token usage so its TPS EMA reflects real work
+    usage = body1.get("usage") or {}
+    lease.complete_with_tokens(
+        int(usage.get("prompt_tokens") or 0),
+        int(usage.get("completion_tokens") or 0),
+    )
+    fo.record_success(endpoint)
+
+    from llmlb_tpu.disagg.gateway import adopter_candidates
+
+    adopter = None
+    adopter_lease = None
+    candidates = adopter_candidates(state, model, capability,
+                                    exclude=fo.failed_ids)
+    if candidates:
+        got = state.load_manager.try_admit(candidates, model, api_kind)
+        if got is not None:
+            adopter, adopter_lease = got
+    if adopter is None:
+        # no decode pool has a free slot right now: the prefill engine
+        # adopts its own payload rather than bouncing the request
+        adopter = endpoint
+        adopter_lease = state.load_manager.begin_request(
+            endpoint, model, api_kind
+        )
+    state.metrics.record_handoff(
+        "self" if adopter.id == endpoint.id else "adopted"
+    )
+
+    adopt_headers = {"Content-Type": "application/json"}
+    if adopter.api_key:
+        adopt_headers["Authorization"] = f"Bearer {adopter.api_key}"
+    rid = headers.get(REQUEST_ID_HEADER)
+    if rid:
+        adopt_headers[REQUEST_ID_HEADER] = rid
+    if deadline_at is not None:
+        # the wire carries the ORIGINAL (partly spent) deadline; the header
+        # overrides it with what actually remains
+        remaining_ms = (deadline_at - time.monotonic()) * 1000.0
+        adopt_headers["X-Request-Deadline-Ms"] = str(
+            max(1, int(remaining_ms))
+        )
+    pairs = state.registry.find_by_model(model, capability)
+    adopt_model = next(
+        (m.model_id for ep2, m in pairs if ep2.id == adopter.id),
+        engine_model,
+    )
+    try:
+        resp2 = await upstream_post(
+            state, adopter, "/v1/handoff",
+            json={
+                "handoff": body1.get("handoff"),
+                "stream": is_stream,
+                "model": adopt_model,
+                "tool_name": body1.get("tool_name"),
+            },
+            headers=adopt_headers, timeout=timeout,
+        )
+    except RETRYABLE_EXCEPTIONS as e:
+        adopter_lease.fail()
+        raise HandoffOrchestrationError(
+            adopter, adopter_lease,
+            "adopt_timeout" if isinstance(e, asyncio.TimeoutError)
+            else "adopt_connect_error",
+        )
+    return resp2, adopter, adopter_lease, adopt_model
 
 
 def _record(
@@ -412,6 +560,20 @@ async def proxy_openai_post(
     wfq_weight = state.admission.weight_for(tenant_name)
     prio = priority_label(body)
 
+    # Disaggregation role steering (docs/disaggregation.md): long-prompt,
+    # cold-prefix requests prefer prefill-capable endpoints; everything
+    # else steers away from prefill-only ones, keeping their slots free
+    # for prefill bursts. None for non-generation capabilities —
+    # embeddings never touch the prefill/decode split.
+    from llmlb_tpu.disagg.gateway import endpoint_role, is_prefill_heavy
+
+    prefill_heavy: bool | None = None
+    if capability in (Capability.CHAT_COMPLETION,
+                      Capability.STRUCTURED_OUTPUTS):
+        prefill_heavy = is_prefill_heavy(
+            state, canonical, estimate_tokens(prompt_text), prefix_hash
+        )
+
     # Failover loop: each attempt re-selects (excluding endpoints that
     # already failed this request), and a failed attempt retries on another
     # endpoint with backoff while the attempt cap and global retry budget
@@ -444,6 +606,7 @@ async def proxy_openai_post(
                 prefix_hash=prefix_hash, exclude=fo.failed_ids,
                 queue_timeout_s=queue_timeout,
                 tenant=tenant, weight=wfq_weight,
+                prefill_heavy=prefill_heavy,
             )
         except QueueTimeout as qt:
             if deadline_at is not None and time.monotonic() >= deadline_at:
@@ -466,7 +629,7 @@ async def proxy_openai_post(
                 404, f"model {model!r} is not available on any online endpoint",
                 "invalid_request_error",
             )
-        endpoint, engine_model, lease = selection
+        endpoint, engine_model, lease, chosen_model = selection
 
         payload = dict(body)
         # registry knows the engine-local name; fall back to the static alias
@@ -504,13 +667,46 @@ async def proxy_openai_post(
         if trace is not None:
             trace.begin("proxy")
         try:
-            upstream = await upstream_post(
-                state, endpoint, path,
-                json=payload,
-                headers=headers,
-                timeout=aiohttp.ClientTimeout(
-                    total=state.config.inference_timeout_s, sock_connect=10
-                ),
+            if (path == "/v1/chat/completions"
+                    and endpoint_role(endpoint, chosen_model) == "prefill"):
+                # Two-phase disaggregated handoff: the selected endpoint
+                # only prefills — it commits the first token(s) and hands
+                # the stream to a decode-capable adopter over the wire
+                # (docs/disaggregation.md). Accounting moves with the
+                # stream: the prefill lease completes at the payload, the
+                # adopter's lease rides the continuation.
+                upstream, endpoint, lease, engine_model = (
+                    await _handoff_upstream(
+                        state, fo, endpoint, lease, canonical, capability,
+                        api_kind, payload, headers, deadline_at, is_stream,
+                        engine_model,
+                    )
+                )
+            else:
+                upstream = await upstream_post(
+                    state, endpoint, path,
+                    json=payload,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=state.config.inference_timeout_s,
+                        sock_connect=10
+                    ),
+                )
+        except HandoffOrchestrationError as e:
+            # phase-2 (adoption) failure: the failure books against the
+            # ADOPTER (its lease already failed inside the orchestrator);
+            # the retry loop re-selects from scratch, excluding it.
+            fo.record_failure(e.endpoint, e.lease, e.reason)
+            if trace is not None:
+                trace.end("proxy")
+            if await fo.should_retry(e.reason):
+                continue
+            _record(state, endpoint=e.endpoint, model=canonical,
+                    api_kind=api_kind, path=path, status=502, started=started,
+                    client_ip=client_ip, auth=auth, error=e.reason,
+                    request_body=stored_body)
+            return error_response(
+                502, f"handoff adoption failed: {e.reason}", "server_error",
             )
         except RETRYABLE_EXCEPTIONS as e:
             reason = ("timeout" if isinstance(e, asyncio.TimeoutError)
@@ -1012,6 +1208,13 @@ async def list_models(request: web.Request) -> web.Response:
                 },
             )
             entry["metadata"]["endpoints"].append(ep.name)
+            # capability UNION across endpoints: with role-split fleets the
+            # first endpoint synced may be prefill-only — the model still
+            # has "decode" somewhere, and clients read this list to know
+            # what the FLEET can do (docs/disaggregation.md)
+            for c in m.capabilities:
+                if c.value not in entry["metadata"]["capabilities"]:
+                    entry["metadata"]["capabilities"].append(c.value)
     return web.json_response({"object": "list", "data": list(seen.values())})
 
 

@@ -534,6 +534,8 @@ async def _api_health(request: web.Request) -> web.Response:
         endpoints.append({
             "name": ep.name,
             "status": ep.status.value,
+            # serving role from the last engine probe (docs/disaggregation.md)
+            "role": ep.accelerator.role or "both",
             "breaker": breaker,
             "latency_ms": ep.latency_ms,
             "consecutive_probe_failures": ep.consecutive_failures,

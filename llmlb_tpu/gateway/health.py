@@ -48,12 +48,18 @@ def _parse_telemetry(body: dict) -> AcceleratorInfo:
     """Tolerant parse of an engine /api/health body. Malformed fields degrade
     to zeros rather than raising — a bad payload from one endpoint must never
     abort the whole health cycle (check_all gathers without return_exceptions)."""
+    from llmlb_tpu.disagg import ROLES
+
     tpu = body.get("tpu") or body.get("gpu")
     tpu = tpu if isinstance(tpu, dict) else {}
     engine = body.get("engine")
     engine = engine if isinstance(engine, dict) else {}
     util = tpu.get("utilization")
+    disagg = body.get("disagg")
+    disagg = disagg if isinstance(disagg, dict) else {}
+    role = disagg.get("role")
     return AcceleratorInfo(
+        role=role if role in ROLES else None,
         accelerator=tpu.get("accelerator") or ("tpu" if "tpu" in body else None),
         chip_count=_as_int(tpu.get("chip_count")),
         hbm_used_bytes=_as_int(tpu.get("hbm_used_bytes")),

@@ -127,6 +127,11 @@ class GatewayMetrics:
         # at gateway-side validation (malformed / unsupported schema)
         self._structured_requests: dict[str, int] = defaultdict(int)
         self._structured_rejected = 0
+        # disaggregated prefill/decode (docs/disaggregation.md): two-phase
+        # handoffs the proxy orchestrated, by outcome — "adopted" (a decode
+        # pool endpoint took the stream) or "self" (no adopter free; the
+        # prefill endpoint continued its own stream)
+        self._handoffs: dict[str, int] = defaultdict(int)
         # SLO goodput accounting: per-model attainment counters against the
         # SloConfig targets; goodput_ratio renders as met/eligible
         self._slo_eligible: dict[str, int] = defaultdict(int)
@@ -218,6 +223,13 @@ class GatewayMetrics:
         """Gateway-side validation refused a structured request (400)."""
         with self._lock:
             self._structured_rejected += 1
+
+    def record_handoff(self, outcome: str) -> None:
+        """One orchestrated prefill→decode handoff; outcome is "adopted"
+        (decode-capable endpoint took the stream) or "self" (fallback:
+        the prefill endpoint adopted its own payload)."""
+        with self._lock:
+            self._handoffs[outcome] += 1
 
     def record_ratelimit_rejection(self, reason: str) -> None:
         """One 429 from the per-key token buckets; reason is 'requests'
@@ -318,6 +330,7 @@ class GatewayMetrics:
                 "structured_requests_total":
                     sum(self._structured_requests.values()),
                 "structured_rejected_total": self._structured_rejected,
+                "handoffs_total": sum(self._handoffs.values()),
                 "slo_eligible_total": sum(self._slo_eligible.values()),
                 "slo_met_total": sum(self._slo_met.values()),
                 "ratelimit_rejections_total":
@@ -437,6 +450,14 @@ class GatewayMetrics:
                 f"llmlb_gateway_structured_rejected_total "
                 f"{self._structured_rejected}"
             )
+            lines.append(
+                "# TYPE llmlb_gateway_handoffs_total counter"
+            )
+            for outcome, n in sorted(self._handoffs.items()):
+                lines.append(
+                    f'llmlb_gateway_handoffs_total'
+                    f'{{outcome="{_escape(outcome)}"}} {n}'
+                )
             for fam, table in (
                 ("llmlb_gateway_slo_eligible_total", self._slo_eligible),
                 ("llmlb_gateway_slo_met_total", self._slo_met),

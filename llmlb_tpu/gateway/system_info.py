@@ -92,6 +92,7 @@ async def _tpu_info(ep: Endpoint, session, headers) -> dict | None:
         return None
     tpu = body.get("tpu") if isinstance(body.get("tpu"), dict) else {}
     engine = body.get("engine") if isinstance(body.get("engine"), dict) else {}
+    disagg = body.get("disagg") if isinstance(body.get("disagg"), dict) else {}
     return {
         "device": tpu.get("device_kind") or tpu.get("accelerator") or "tpu",
         "chip_count": tpu.get("chip_count"),
@@ -100,6 +101,9 @@ async def _tpu_info(ep: Endpoint, session, headers) -> dict | None:
         "num_slots": engine.get("num_slots"),
         "active_slots": engine.get("active_slots"),
         "queued": engine.get("queued"),
+        # disaggregation role + live handoff figures (docs/disaggregation.md)
+        "role": disagg.get("role") or "both",
+        "handoff_backlog": disagg.get("handoff_backlog"),
         "source": "api_health",
     }
 

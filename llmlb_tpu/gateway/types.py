@@ -41,6 +41,13 @@ class Capability(str, enum.Enum):
     # tool_choice). Advertised by tpu:// engines in /v1/models; the gateway
     # steers constrained requests to endpoints that have it.
     STRUCTURED_OUTPUTS = "structured_outputs"
+    # Disaggregated prefill/decode roles (docs/disaggregation.md): tpu://
+    # engines advertise which phase(s) they serve on the /v1/models
+    # capability list; the balancer steers prefill-heavy requests toward
+    # PREFILL-capable endpoints and handoff adoption toward DECODE-capable
+    # ones. Engines running --role both/split advertise both.
+    PREFILL = "prefill"
+    DECODE = "decode"
 
 
 class Role(str, enum.Enum):
@@ -91,6 +98,12 @@ class AcceleratorInfo:
     queue_depth: int = 0  # requests waiting for a slot
     active_slots: int = 0
     num_slots: int = 0
+    # Disaggregation role from the engine's /api/health disagg block
+    # (docs/disaggregation.md): "both" | "split" | "prefill" | "decode";
+    # None for endpoints that do not advertise one (treated as "both").
+    # Re-parsed on every probe, so a restarted engine whose role changed
+    # re-routes within one probe interval.
+    role: str | None = None
     sampled_at: float = 0.0  # when the probe captured this; 0 = never
 
     @property

@@ -646,7 +646,16 @@ def prefill_into_pages(
 ):
     """Prefill B prompts and scatter their KV through the block tables into
     the global page pool — the paged counterpart of prefill_into_slots.
-    Returns (last_logits [B, V] fp32, cache_k, cache_v)."""
+    Returns (last_logits [B, V] fp32, cache_k, cache_v).
+
+    HANDOFF CONTRACT (docs/disaggregation.md): this entry point (and the
+    extend/CP variants) is handoff-shaped — row i of `last_logits` is the
+    FINAL-position logits of prompt i, and every KV row lands at its
+    absolute token position. Split mode stages exactly this logits row
+    for a later decode-pool adoption (the first token samples from it),
+    and the cross-process replay depends on position-exact KV so the
+    adopted continuation is token-identical. A family that fused
+    prefill+sample, or wrote KV at relative positions, would break both."""
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
         make_write_kv_pages(block_tables, kv_pool_values(cache_k).shape[2]),

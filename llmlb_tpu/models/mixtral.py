@@ -257,7 +257,12 @@ def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_
 def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
                        block_tables, cache_k, cache_v,
                        mesh: Mesh | None = None):
-    """Paged insert path. Same contract as llama.prefill_into_pages."""
+    """Paged insert path. Same contract as llama.prefill_into_pages —
+    including its HANDOFF CONTRACT (docs/disaggregation.md): final-row
+    logits aligned to batch rows and position-exact KV, so split-mode
+    staging and cross-process replay hold for MoE engines too (the router
+    is position-independent; expert choice rides the token, not the
+    slot, so a handed-off stream routes identically on the adopter)."""
     b, t = input_ids.shape
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,

@@ -68,7 +68,8 @@ def engine_metric_names() -> set[str]:
             "flops_per_token": 0.0, "bytes_per_token": 0.0,
         },
         quant={"mode": "all", "param_bytes": 0},
-        sched={"queued_by_class": {"high": 0, "normal": 0, "low": 0}},
+        sched={"queued_by_class": {"high": 0, "normal": 0, "low": 0},
+               "queued_by_role": {"prefill": 0, "decode": 0}},
     )
     return set(_TYPE_RE.findall(text))
 

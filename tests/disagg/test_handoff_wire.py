@@ -1,0 +1,162 @@
+"""Handoff-wire round trip (the cross-process counterpart of
+tests/engine/test_plan_wire.py): every SamplingParams field must survive
+`handoff_payload` → JSON → `parse_handoff`, so forgetting a field when
+adding a knob is a TEST FAILURE instead of a silently-desynced adopted
+stream — `deadline_ms` and `priority` riding the handoff are exactly what
+this guards (docs/disaggregation.md).
+
+Same auto-coverage trick as the plan-wire test: a distinctive non-default
+probe value is synthesized for EVERY declared field from its annotation, so
+a newly declared field is covered the moment it exists.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from llmlb_tpu.disagg import (
+    HANDOFF_WIRE_VERSION,
+    HandoffError,
+    handoff_payload,
+    parse_handoff,
+)
+from llmlb_tpu.engine.scheduler import SamplingParams
+
+
+def _distinct_value(field: dataclasses.Field):
+    """A JSON-safe value distinguishable from the field's default, derived
+    from the annotation so newly added fields get covered automatically."""
+    ann = str(field.type)
+    if "dict" in ann:
+        return {"probe": field.name, "n": 3}
+    if "bool" in ann:
+        default = field.default
+        return not default if isinstance(default, bool) else True
+    if "float" in ann:
+        return 0.125
+    if "int" in ann:
+        return 7
+    if "str" in ann:
+        return f"probe-{field.name}"
+    raise AssertionError(
+        f"SamplingParams.{field.name}: add a wire-probe rule for {ann!r} "
+        "(and make sure the field is JSON-safe for the handoff wire)"
+    )
+
+
+def _probe_params() -> SamplingParams:
+    return SamplingParams(**{
+        f.name: _distinct_value(f) for f in dataclasses.fields(SamplingParams)
+    })
+
+
+def _roundtrip(payload: dict) -> dict:
+    """The exact cross-process path: the payload crosses as JSON text."""
+    return json.loads(json.dumps(payload))
+
+
+def test_every_sampling_field_survives_the_handoff_wire():
+    params = _probe_params()
+    payload = _roundtrip(handoff_payload([1, 2, 3], [9, 9], params,
+                                         stop=["\n\n"], request_id="rid-1"))
+    prompt, committed, sampling, stop, rid, t = parse_handoff(payload)
+    assert prompt == [1, 2, 3]
+    assert committed == [9, 9]
+    assert stop == ["\n\n"]
+    assert rid == "rid-1"
+    assert t > 0
+    for f in dataclasses.fields(SamplingParams):
+        assert getattr(sampling, f.name) == getattr(params, f.name), (
+            f"SamplingParams.{f.name} was lost or mangled on the "
+            "handoff wire"
+        )
+
+
+def test_probe_values_differ_from_defaults():
+    """The round-trip assertion is only meaningful if the probe differs
+    from the default (a dropped field that deserializes to its default
+    must FAIL the wire test)."""
+    params = _probe_params()
+    defaults = SamplingParams()
+    for f in dataclasses.fields(SamplingParams):
+        assert getattr(params, f.name) != getattr(defaults, f.name), (
+            f"probe for SamplingParams.{f.name} equals its default; "
+            "_distinct_value needs a better rule"
+        )
+
+
+def test_deadline_and_priority_ride_the_wire_verbatim():
+    """The PR 11 bugfix satellite, stated explicitly on top of the generic
+    probe: a request handed from the prefill pool to the decode pool keeps
+    its scheduling class and its deadline."""
+    params = SamplingParams(priority=2, deadline_ms=1500.0, seed=42)
+    payload = _roundtrip(handoff_payload([5], [1], params))
+    _, _, sampling, _, _, _ = parse_handoff(payload)
+    assert sampling.priority == 2
+    assert sampling.deadline_ms == 1500.0
+    assert sampling.seed == 42
+
+
+def test_constraint_and_speculative_ride_verbatim():
+    params = SamplingParams(
+        constraint={"type": "json_object"},
+        speculative={"enabled": True, "max_draft_tokens": 6},
+    )
+    payload = _roundtrip(handoff_payload([5], [], params))
+    _, _, sampling, _, _, _ = parse_handoff(payload)
+    assert sampling.constraint == {"type": "json_object"}
+    assert sampling.speculative == {"enabled": True, "max_draft_tokens": 6}
+
+
+# ------------------------------------------------------------- validation
+
+
+def _valid() -> dict:
+    return handoff_payload([1, 2], [3], SamplingParams())
+
+
+def test_rejects_wrong_version():
+    payload = _valid()
+    payload["version"] = HANDOFF_WIRE_VERSION + 1
+    with pytest.raises(HandoffError, match="version"):
+        parse_handoff(payload)
+
+
+def test_rejects_unknown_sampling_fields():
+    """A NEWER prefill engine's extra field must refuse loudly — silently
+    dropping it would desync the adopted continuation."""
+    payload = _valid()
+    payload["sampling"]["from_the_future"] = 1
+    with pytest.raises(HandoffError, match="from_the_future"):
+        parse_handoff(payload)
+
+
+@pytest.mark.parametrize("mutate, match", [
+    (lambda p: p.pop("prompt_ids"), "prompt_ids"),
+    (lambda p: p.update(prompt_ids=[]), "prompt_ids"),
+    (lambda p: p.update(prompt_ids=["x"]), "integers"),
+    (lambda p: p.update(committed_ids="nope"), "committed_ids"),
+    (lambda p: p.update(sampling=None), "sampling"),
+    (lambda p: p.update(stop="raw-string"), "stop"),
+    (lambda p: p.update(request_id=7), "request_id"),
+])
+def test_rejects_malformed_payloads(mutate, match):
+    payload = _valid()
+    mutate(payload)
+    with pytest.raises(HandoffError, match=match):
+        parse_handoff(payload)
+
+
+def test_rejects_non_object_payload():
+    with pytest.raises(HandoffError):
+        parse_handoff(None)
+    with pytest.raises(HandoffError):
+        parse_handoff([1, 2, 3])
+
+
+def test_rejects_implausible_token_counts():
+    payload = _valid()
+    payload["committed_ids"] = list(range(4_000_001))
+    with pytest.raises(HandoffError, match="implausibly"):
+        parse_handoff(payload)

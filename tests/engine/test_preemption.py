@@ -32,14 +32,27 @@ SCHEMA = {
 }
 
 
-# One slot: the victim owns it, so a high-priority arrival MUST preempt —
-# no scheduling ambiguity about which slot parks.
-@pytest.fixture(scope="module", params=["paged", "dense", "paged-spec"])
+# One DECODING slot: the victim owns it, so a high-priority arrival MUST
+# preempt — no scheduling ambiguity about which slot parks. The split
+# params run the same suite over the disaggregated engine (PR 11): one
+# prefill slot + one decode slot, so the interloper's handoff adoption is
+# the preemption point — the victim parks MID-GENERATION, resumes through
+# the prefill pool, and hands off a second time. Bit-identity must hold
+# across park + double handoff, grammar cursor and drafter riding along.
+@pytest.fixture(scope="module",
+                params=["paged", "dense", "paged-spec", "split",
+                        "split-spec"])
 def engine(request):
     layout = "dense" if request.param == "dense" else "paged"
-    extra = {"spec_decode": True} if request.param == "paged-spec" else {}
+    extra = {}
+    if request.param.endswith("spec"):
+        extra["spec_decode"] = True
+    if request.param.startswith("split"):
+        extra["role"] = "split"
+        extra["disagg_prefill_slots"] = 1
+    slots = 2 if request.param.startswith("split") else 1
     eng = Engine.from_preset(
-        "debug-tiny", num_slots=1, slot_capacity=128,
+        "debug-tiny", num_slots=slots, slot_capacity=128,
         prefill_buckets=(16, 32), seed=0,
         kv_layout=layout, kv_page_size=16, **extra,
     )

@@ -206,7 +206,7 @@ def test_queue_wait_latency_regression_end_to_end():
             state, "m", Capability.CHAT_COMPLETION, TpsApiKind.CHAT
         )
         assert first is not None
-        _, _, lease = first
+        _, _, lease, _ = first
 
         async def parked():
             return await select_endpoint_with_queue(

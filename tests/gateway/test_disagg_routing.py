@@ -1,0 +1,371 @@
+"""Role-aware gateway routing for disaggregated prefill/decode
+(docs/disaggregation.md): role surfaces on /api/endpoints, /api/health and
+/v1/models; the health probe re-reads role every cycle; prefill-heavy
+requests steer to prefill-capable endpoints; a prefill-only selection
+triggers the two-phase handoff (prefill there, adopt on a decode-capable
+endpoint) with SamplingParams extras surviving the wire; and the fallback
+self-adoption keeps requests servable with no decode pool online.
+"""
+
+import asyncio
+import json
+
+from llmlb_tpu.disagg.gateway import (
+    decode_capable,
+    endpoint_role,
+    prefill_capable,
+    role_filter,
+)
+from llmlb_tpu.gateway.types import (
+    AcceleratorInfo,
+    Capability,
+    EndpointStatus,
+    EndpointType,
+)
+from tests.support import GatewayHarness, MockDisaggEndpoint
+
+# comfortably past the 256-token prefill-heavy threshold
+LONG_PROMPT = "please summarize this document carefully. " * 200
+SHORT_PROMPT = "hi there"
+
+
+def _set_role(gw, ep, role):
+    gw.state.registry.update_status(
+        ep.id, EndpointStatus.ONLINE,
+        accelerator=AcceleratorInfo(role=role, sampled_at=1.0),
+    )
+
+
+def _chat_caps(*roles):
+    return [Capability.CHAT_COMPLETION] + [Capability(r) for r in roles]
+
+
+async def _chat(gw, prompt, **extra):
+    resp = await gw.client.post(
+        "/v1/chat/completions",
+        json={"model": "m", "messages": [
+            {"role": "user", "content": prompt}], **extra},
+        headers=await gw.inference_headers(),
+    )
+    assert resp.status == 200, await resp.text()
+    return await resp.json()
+
+
+# ---------------------------------------------------------------- unit layer
+
+
+def test_role_helpers_and_filter():
+    class _Ep:
+        def __init__(self, role):
+            self.accelerator = AcceleratorInfo(role=role)
+
+    both, split = _Ep(None), _Ep("split")
+    pre, dec = _Ep("prefill"), _Ep("decode")
+    assert endpoint_role(both) == "both"
+    assert prefill_capable(pre) and not decode_capable(pre)
+    assert decode_capable(dec) and not prefill_capable(dec)
+    assert prefill_capable(split) and decode_capable(split)
+    eps = [both, split, pre, dec]
+    assert role_filter(eps, prefill_heavy=True) == [both, split, pre]
+    assert role_filter(eps, prefill_heavy=False) == [both, split, dec]
+    # soft: an empty preference falls back to the input unchanged
+    assert role_filter([pre], prefill_heavy=False) == [pre]
+    assert role_filter([dec], prefill_heavy=True) == [dec]
+
+
+def test_role_capability_fallback_without_probe_telemetry():
+    """Multi-worker: only the elected primary probes /api/health, so sibling
+    workers have no accelerator.role — the role derived from the SYNCED
+    capability list (persisted in the shared DB) must carry routing."""
+    from llmlb_tpu.gateway.types import EndpointModel
+
+    class _Ep:
+        accelerator = AcceleratorInfo()  # never probed
+
+    def model(*roles):
+        return EndpointModel(
+            endpoint_id="e", model_id="m", canonical_name="m",
+            capabilities=_chat_caps(*roles),
+        )
+
+    ep = _Ep()
+    assert endpoint_role(ep, model("prefill")) == "prefill"
+    assert endpoint_role(ep, model("decode")) == "decode"
+    assert endpoint_role(ep, model("prefill", "decode")) == "both"
+    assert endpoint_role(ep, model()) == "both"
+    # a probed role beats the capability fallback
+    probed = _Ep()
+    probed.accelerator = AcceleratorInfo(role="split")
+    assert endpoint_role(probed, model("prefill")) == "split"
+
+
+def test_routing_steers_on_capabilities_alone():
+    """Same steering as test_short_prompts_avoid_prefill_only_endpoints but
+    with NO probe telemetry set — the non-primary-worker view."""
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        dec = await MockDisaggEndpoint(role="decode", model="m").start()
+        try:
+            gw.register_mock(pre.url, ["m"], name="pre",
+                             capabilities=_chat_caps("prefill"))
+            gw.register_mock(dec.url, ["m"], name="dec",
+                             capabilities=_chat_caps("decode"))
+            for _ in range(3):
+                await _chat(gw, SHORT_PROMPT, max_tokens=8)
+            assert len(dec.requests_seen) == 3
+            assert pre.requests_seen == []
+            # long prompt: capability-derived prefill role still triggers
+            # the two-phase handoff
+            await _chat(gw, LONG_PROMPT, max_tokens=8)
+            assert len(pre.prefill_calls) == 1
+            assert len(dec.adopt_calls) == 1
+        finally:
+            await pre.stop()
+            await dec.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------- role surfaces
+
+
+def test_role_surfaces_and_probe_rereads_role():
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = await MockDisaggEndpoint(role="prefill", model="m").start()
+        try:
+            ep = gw.register_mock(mock.url, ["m"],
+                                  endpoint_type=EndpointType.TPU,
+                                  capabilities=_chat_caps("prefill"))
+
+            from llmlb_tpu.gateway.health import EndpointHealthChecker
+
+            checker = EndpointHealthChecker(
+                gw.state.registry, gw.state.load_manager, gw.state.db,
+                gw.state.http,
+            )
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert gw.state.registry.get(ep.id).accelerator.role == "prefill"
+
+            # /api/endpoints and /api/health carry the probed role
+            resp = await gw.client.get("/api/endpoints",
+                                       headers=await gw.admin_headers())
+            body = await resp.json()
+            assert body["endpoints"][0]["role"] == "prefill"
+            resp = await gw.client.get("/api/health")
+            health = await resp.json()
+            assert health["endpoints"][0]["role"] == "prefill"
+
+            # /v1/models capability list carries the role entries
+            resp = await gw.client.get("/v1/models",
+                                       headers=await gw.inference_headers())
+            models = await resp.json()
+            caps = models["data"][0]["metadata"]["capabilities"]
+            assert "prefill" in caps
+
+            # an engine restarted under a NEW role re-routes within one
+            # probe: the checker re-reads role on every cycle
+            mock.role = "decode"
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            assert gw.state.registry.get(ep.id).accelerator.role == "decode"
+        finally:
+            await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- routing + handoff
+
+
+def test_short_prompts_avoid_prefill_only_endpoints():
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        dec = await MockDisaggEndpoint(role="decode", model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            ep_dec = gw.register_mock(dec.url, ["m"], name="dec",
+                                      capabilities=_chat_caps("decode"))
+            _set_role(gw, ep_pre, "prefill")
+            _set_role(gw, ep_dec, "decode")
+            for _ in range(4):
+                await _chat(gw, SHORT_PROMPT, max_tokens=8)
+            # every short request landed on the decode-capable endpoint;
+            # the prefill-only endpoint saw no /v1/chat/completions at all
+            assert len(dec.requests_seen) == 4
+            assert pre.requests_seen == []
+        finally:
+            await pre.stop()
+            await dec.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_prefill_heavy_requests_orchestrate_the_two_phase_handoff():
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        dec = await MockDisaggEndpoint(role="decode", model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            ep_dec = gw.register_mock(dec.url, ["m"], name="dec",
+                                      capabilities=_chat_caps("decode"))
+            _set_role(gw, ep_pre, "prefill")
+            _set_role(gw, ep_dec, "decode")
+            body = await _chat(gw, LONG_PROMPT, max_tokens=8,
+                               priority="low")
+            # phase 1 hit the prefill endpoint, phase 2 the decode endpoint,
+            # and the client got the adopter's completion
+            assert len(pre.prefill_calls) == 1
+            assert len(dec.adopt_calls) == 1
+            content = json.loads(
+                body["choices"][0]["message"]["content"]
+            )
+            assert content["adopted_by"] == "decode"
+            assert content["committed"] == [7]
+            # SamplingParams extras survived the handoff wire
+            assert content["priority"] == 2
+            assert gw.state.metrics.summary()["handoffs_total"] == 1
+        finally:
+            await pre.stop()
+            await dec.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_handoff_streaming_relays_the_adopters_sse():
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        dec = await MockDisaggEndpoint(role="decode", model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            ep_dec = gw.register_mock(dec.url, ["m"], name="dec",
+                                      capabilities=_chat_caps("decode"))
+            _set_role(gw, ep_pre, "prefill")
+            _set_role(gw, ep_dec, "decode")
+            resp = await gw.client.post(
+                "/v1/chat/completions",
+                json={"model": "m", "stream": True, "max_tokens": 8,
+                      "messages": [{"role": "user", "content": LONG_PROMPT}]},
+                headers=await gw.inference_headers(),
+            )
+            assert resp.status == 200
+            assert "text/event-stream" in resp.headers.get("Content-Type", "")
+            text = ""
+            async for line in resp.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    chunk = json.loads(line[6:])
+                    for ch in chunk.get("choices", []):
+                        text += (ch.get("delta") or {}).get("content") or ""
+            assert json.loads(text)["adopted_by"] == "decode"
+            assert dec.adopt_calls[0]["stream"] is True
+        finally:
+            await pre.stop()
+            await dec.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_deadline_rides_the_adopt_request_as_remaining_budget():
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        dec = await MockDisaggEndpoint(role="decode", model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            ep_dec = gw.register_mock(dec.url, ["m"], name="dec",
+                                      capabilities=_chat_caps("decode"))
+            _set_role(gw, ep_pre, "prefill")
+            _set_role(gw, ep_dec, "decode")
+            resp = await gw.client.post(
+                "/v1/chat/completions",
+                json={"model": "m", "max_tokens": 8,
+                      "messages": [{"role": "user", "content": LONG_PROMPT}]},
+                headers={**await gw.inference_headers(),
+                         "X-Request-Deadline-Ms": "30000"},
+            )
+            assert resp.status == 200
+            # the wire payload carries the deadline as the prefill engine
+            # received it (already decremented by gateway queue time); the
+            # adopt request's header carries what remains AFTER prefill —
+            # monotonically shrinking, never absent
+            payload = dec.adopt_calls[0]["handoff"]
+            wire_deadline = payload["sampling"]["deadline_ms"]
+            assert 0 < wire_deadline <= 30000.0
+            remaining = float(
+                dec.adopt_headers[0]["X-Request-Deadline-Ms"]
+            )
+            assert 0 < remaining <= wire_deadline
+        finally:
+            await pre.stop()
+            await dec.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_generic_endpoints_never_receive_the_handoff_wire():
+    """Mixed fleet: a generic OpenAI-compatible endpoint defaults to role
+    "both" for STEERING, but it has no /v1/handoff route — adoption must
+    require an EXPLICIT decode advertisement, so the payload goes back to
+    the originating engine (self-adoption), never at the generic box."""
+    from llmlb_tpu.disagg.gateway import speaks_handoff_wire
+    from llmlb_tpu.gateway.types import EndpointModel
+    from tests.support import MockOpenAIEndpoint
+
+    class _Ep:
+        accelerator = AcceleratorInfo()
+
+    plain_model = EndpointModel(endpoint_id="e", model_id="m",
+                                canonical_name="m")
+    assert decode_capable(_Ep(), plain_model)  # steering default...
+    assert not speaks_handoff_wire(_Ep(), plain_model)  # ...but no wire
+
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        plain = await MockOpenAIEndpoint(model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            gw.register_mock(plain.url, ["m"], name="plain")
+            _set_role(gw, ep_pre, "prefill")
+            body = await _chat(gw, LONG_PROMPT, max_tokens=8)
+            # the prefill engine adopted its own payload; the generic
+            # endpoint saw neither a handoff nor a 404
+            assert len(pre.prefill_calls) == 1
+            assert len(pre.adopt_calls) == 1
+            content = json.loads(body["choices"][0]["message"]["content"])
+            assert content["adopted_by"] == "prefill"
+        finally:
+            await pre.stop()
+            await plain.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_no_adopter_falls_back_to_self_adoption():
+    async def run():
+        gw = await GatewayHarness.create()
+        pre = await MockDisaggEndpoint(role="prefill", model="m").start()
+        try:
+            ep_pre = gw.register_mock(pre.url, ["m"], name="pre",
+                                      capabilities=_chat_caps("prefill"))
+            _set_role(gw, ep_pre, "prefill")
+            body = await _chat(gw, LONG_PROMPT, max_tokens=8)
+            # no decode-capable endpoint online: the prefill endpoint
+            # adopted its own payload instead of bouncing the request
+            assert len(pre.prefill_calls) == 1
+            assert len(pre.adopt_calls) == 1
+            content = json.loads(body["choices"][0]["message"]["content"])
+            assert content["adopted_by"] == "prefill"
+        finally:
+            await pre.stop()
+            await gw.close()
+    asyncio.run(run())

@@ -105,6 +105,7 @@ def test_engine_metrics_exposition_valid():
         "llmlb_engine_decode_step_seconds",
         "llmlb_engine_schema_compile_seconds",
         "llmlb_engine_step_phase_seconds",
+        "llmlb_engine_handoff_latency_seconds",
     }
     assert "llmlb_engine_batch_occupancy 5" in text
     assert "llmlb_engine_slow_steps_total 1" in text

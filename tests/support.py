@@ -148,6 +148,133 @@ class MockOpenAIEndpoint:
         })
 
 
+class MockDisaggEndpoint(MockOpenAIEndpoint):
+    """A mock tpu:// engine with a disaggregation role: advertises the role
+    on /v1/models capabilities and /api/health, answers /v1/handoff/prefill
+    with a real wire payload (prefill role), and adopts payloads on
+    /v1/handoff (decode role). The adopt reply's content embeds what
+    arrived on the wire so tests can assert fields survived."""
+
+    def __init__(self, *, role="both", model="mock-model",
+                 tokens_per_reply=5, handoff_fail_with=None):
+        super().__init__(model=model, tokens_per_reply=tokens_per_reply)
+        self.role = role
+        self.handoff_fail_with = handoff_fail_with
+        self.prefill_calls: list[dict] = []  # /v1/handoff/prefill bodies
+        self.adopt_calls: list[dict] = []  # /v1/handoff bodies
+        self.adopt_headers: list[dict] = []
+
+    async def start(self) -> "MockDisaggEndpoint":
+        app = web.Application()
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_get("/api/health", self._health)
+        app.router.add_post("/v1/handoff/prefill", self._prefill)
+        app.router.add_post("/v1/handoff", self._adopt)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def _models(self, request):
+        caps = ["chat_completion"]
+        if self.role in ("both", "split", "prefill"):
+            caps.append("prefill")
+        if self.role in ("both", "split", "decode"):
+            caps.append("decode")
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model, "object": "model",
+                      "capabilities": caps, "role": self.role}],
+        })
+
+    async def _health(self, request):
+        return web.json_response({
+            "status": "ok",
+            "tpu": {"accelerator": "tpu", "chip_count": 1},
+            "engine": {"num_slots": 4, "active_slots": 0, "queued": 0},
+            "disagg": {"role": self.role, "split": self.role == "split",
+                       "handoff_total": {}, "handoff_backlog": 0},
+        })
+
+    async def _prefill(self, request):
+        from llmlb_tpu.disagg import handoff_payload
+        from llmlb_tpu.engine.scheduler import SamplingParams
+
+        body = await request.json()
+        self.prefill_calls.append(
+            {"body": body, "headers": dict(request.headers)}
+        )
+        if self.handoff_fail_with:
+            return web.json_response({"error": "induced"},
+                                     status=self.handoff_fail_with)
+        deadline = request.headers.get("X-Request-Deadline-Ms")
+        sampling = SamplingParams(
+            temperature=float(body.get("temperature") or 1.0),
+            max_tokens=int(body.get("max_tokens") or 16),
+            priority={"high": 0, "normal": 1, "low": 2}.get(
+                body.get("priority"), body.get("priority") or 1
+            ) if body.get("priority") is not None else 1,
+            deadline_ms=float(deadline) if deadline else None,
+        )
+        payload = handoff_payload(
+            [1, 2, 3], [7], sampling,
+            request_id=request.headers.get("X-Request-Id"),
+        )
+        return web.json_response({
+            "object": "llmlb.handoff", "model": self.model,
+            "handoff": payload, "finish": None, "tool_name": None,
+            "usage": {"prompt_tokens": 3, "completion_tokens": 1,
+                      "total_tokens": 4},
+        })
+
+    async def _adopt(self, request):
+        body = await request.json()
+        self.adopt_calls.append(body)
+        self.adopt_headers.append(dict(request.headers))
+        handoff = body.get("handoff") or {}
+        sampling = handoff.get("sampling") or {}
+        content = json.dumps({
+            "adopted_by": self.role,
+            "committed": handoff.get("committed_ids"),
+            "priority": sampling.get("priority"),
+            "deadline_ms": sampling.get("deadline_ms"),
+        })
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            chunk = {
+                "id": "chatcmpl-adopt", "object": "chat.completion.chunk",
+                "model": body.get("model"),
+                "choices": [{"index": 0, "delta": {"content": content},
+                             "finish_reason": None}],
+            }
+            await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            final = {
+                "id": "chatcmpl-adopt", "object": "chat.completion.chunk",
+                "model": body.get("model"),
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "stop"}],
+                "usage": {"prompt_tokens": 3, "completion_tokens": 5,
+                          "total_tokens": 8},
+            }
+            await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        return web.json_response({
+            "id": "chatcmpl-adopt", "object": "chat.completion",
+            "model": body.get("model"),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": "stop",
+            }],
+            "usage": {"prompt_tokens": 3, "completion_tokens": 5,
+                      "total_tokens": 8},
+        })
+
+
 class MockOllamaEndpoint:
     """Speaks Ollama's discovery surface (/api/tags) for detection/sync tests."""
 
