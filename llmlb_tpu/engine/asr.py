@@ -95,8 +95,8 @@ class AsrEngine:
             from transformers import WhisperTokenizer
 
             tokenizer = WhisperTokenizer.from_pretrained(model_dir)
-        except Exception:
-            pass
+        except Exception:  # allow-silent: optional dependency — byte
+            pass           # fallback tokenizer below serves without it
         return cls(cfg, params, tokenizer,
                    model_id or os.path.basename(model_dir.rstrip("/")))
 

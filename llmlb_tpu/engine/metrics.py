@@ -139,6 +139,12 @@ class EngineMetrics:
         }
         self.handoff_latency = Histogram(STEP_BUCKETS)
         self.handoff_backlog = 0
+        # Graceful drain (docs/deployment.md): 1 while the engine refuses
+        # new admissions and winds down, plus the decoding slots parked when
+        # the drain grace expired (their streams resume on another engine
+        # via the gateway's replay path).
+        self.drain_state = 0
+        self.drain_parked_total = 0
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -284,6 +290,14 @@ class EngineMetrics:
         with self._lock:
             self.handoff_backlog = n
 
+    def set_drain_state(self, state: int) -> None:
+        with self._lock:
+            self.drain_state = int(state)
+
+    def record_drain_park(self) -> None:
+        with self._lock:
+            self.drain_parked_total += 1
+
     def record_request_done(self, finish: str) -> None:
         with self._lock:
             self.requests_total += 1
@@ -329,6 +343,8 @@ class EngineMetrics:
                 "handoff_total": dict(self.handoff_total),
                 "handoff_backlog": self.handoff_backlog,
                 "handoff_latency_p50_s": self.handoff_latency.percentile(50),
+                "drain_state": self.drain_state,
+                "drain_parked_total": self.drain_parked_total,
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
@@ -431,6 +447,10 @@ class EngineMetrics:
             lines += [
                 "# TYPE llmlb_engine_handoff_backlog gauge",
                 f"llmlb_engine_handoff_backlog {self.handoff_backlog}",
+                "# TYPE llmlb_engine_drain_state gauge",
+                f"llmlb_engine_drain_state {self.drain_state}",
+                "# TYPE llmlb_engine_drain_parked_total counter",
+                f"llmlb_engine_drain_parked_total {self.drain_parked_total}",
             ]
             if sched is not None:
                 lines.append(

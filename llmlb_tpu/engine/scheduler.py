@@ -599,6 +599,15 @@ class EngineCore:
         self.coordinator = None
         self._replicate = None
         self._stop_requested = False
+        # Graceful drain (docs/deployment.md): while draining the step loop
+        # admits nothing new — in-flight decodes run to completion under the
+        # server's grace window; `request_drain_park` then asks the NEXT
+        # loop iteration (slot state is loop-thread-owned) to park every
+        # decoding slot through the PR 10 park path so the gateway's
+        # mid-stream resume can move those streams to another engine.
+        self.draining = False
+        self._drain_park_requested = False
+        self._drain_flush_requested = False
         # Cancellations take effect ONLY via the plan in multihost mode: the
         # live .cancelled flag flips at arbitrary times on the leader (HTTP
         # thread), and acting on it directly would make hosts dispatch
@@ -1109,6 +1118,58 @@ class EngineCore:
             tokens_dev = self._replicate(tokens_dev)
         return np.asarray(tokens_dev)
 
+    def drain_active(self) -> bool:
+        """True while the engine refuses new admissions (graceful drain)."""
+        return self.draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting new work; in-flight slots keep decoding. One-way —
+        the draining process exits or is restarted by its supervisor."""
+        self.draining = True
+
+    def request_drain_park(self) -> None:
+        """Ask the step loop to park every decoding slot at its next
+        iteration (the drain grace expired). Thread-safe: a plain bool write
+        consumed by the loop thread, like Request.cancelled."""
+        self._drain_park_requested = True
+
+    def request_drain_flush(self) -> None:
+        """Ask the step loop to terminal-error everything still queued
+        (parked-for-drain work included). Called AFTER the drain aborted
+        the in-flight connections: the committed tokens live on in the
+        gateway's replay ledger, but the HTTP handlers blocked on these
+        requests' event queues must unblock or they would pin executor
+        threads (and the server's shutdown) forever."""
+        self._drain_flush_requested = True
+
+    def _drain_flush_all(self) -> None:
+        """Loop thread only (queues are loop-thread-owned)."""
+        self._drain_pending()
+        flushed: list[Request] = []
+        for p in PRIORITY_CLASSES:
+            q = self._class_queues[p]
+            while q:
+                flushed.append(q.popleft())
+        if self._held_request is not None:
+            flushed.append(self._held_request)
+            self._held_request = None
+        for request in flushed:
+            request.events.put(("error", "engine draining"))
+            self.metrics.record_request_done("error")
+        if flushed:
+            log.info("drain flushed %d queued request(s)", len(flushed))
+
+    def _drain_park_all(self) -> None:
+        """Park every parkable decoding slot (loop thread only). Prefilling
+        and first_pending slots cannot park (incomplete KV / device-only
+        last token) — their connections are aborted by the server instead,
+        and the gateway resumes them from its own replay ledger."""
+        for i, slot in enumerate(self.slots):
+            if (slot.request is not None and not slot.prefilling
+                    and not slot.first_pending and not slot.handoff_ready):
+                self._park_slot(i)
+                self.metrics.record_drain_park()
+
     def _loop(self) -> None:
         while self._running:
             did_work = False
@@ -1117,6 +1178,12 @@ class EngineCore:
                     self._lockstep_tick()
                     if not self._running:
                         break
+                if self._drain_park_requested:
+                    self._drain_park_requested = False
+                    self._drain_park_all()
+                if self._drain_flush_requested:
+                    self._drain_flush_requested = False
+                    self._drain_flush_all()
                 did_work |= self._try_insert()
                 # At most ONE prefill chunk per iteration: decode steps run
                 # between chunks, so active slots keep emitting tokens during
@@ -1580,6 +1647,10 @@ class EngineCore:
         return kept
 
     def _try_insert(self) -> bool:
+        if self.draining:
+            # graceful drain: nothing new is admitted or re-activated —
+            # parked work stays queued for the gateway's resume to collect
+            return False
         plan_start = time.perf_counter()
         self._prefill_spent_iter = 0  # first call of every loop iteration
         self._drain_pending()

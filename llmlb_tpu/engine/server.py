@@ -202,6 +202,151 @@ async def _sse_send(resp: web.StreamResponse, payload: dict | str) -> None:
     await resp.write(f"data: {data}\n\n".encode())
 
 
+def _drain_grace_from_env() -> float:
+    import os
+
+    raw = os.environ.get("LLMLB_DRAIN_GRACE_S")
+    if raw:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            log.warning("LLMLB_DRAIN_GRACE_S=%r is not a number; using 30",
+                        raw)
+    return 30.0
+
+
+class DrainController:
+    """Graceful engine drain (docs/deployment.md rolling-restart runbook).
+
+    SIGTERM (via the aiohttp shutdown hook) and ``POST /api/drain`` both land
+    here: the server flips to draining — new /v1 admissions 503 with an
+    honest Retry-After, /api/health advertises ``draining`` so the gateway's
+    health checker re-routes within one probe — while in-flight decodes get
+    ``LLMLB_DRAIN_GRACE_S`` to finish. Anything still running when the grace
+    expires is parked through the PR 10 park path (pages freed, resume state
+    captured, counted in llmlb_engine_drain_parked_total) and its client
+    connection hard-aborted, so the GATEWAY's mid-stream resume replays the
+    committed tokens onto another engine. Drain is one-way: the process is
+    expected to exit (SIGTERM) or be restarted by its supervisor."""
+
+    def __init__(self, engine: Engine, grace_s: float | None = None):
+        self.engine = engine
+        self.grace_s = (_drain_grace_from_env()
+                        if grace_s is None else max(0.0, float(grace_s)))
+        self.draining = False
+        self.started_at = 0.0
+        self.parked = 0
+        self.aborted_connections = 0
+        # transports of in-flight POST /v1/* requests (the drain middleware
+        # maintains this); aborting them after the grace is what turns a
+        # straggler into a gateway-visible cut the resume path picks up
+        self._streams: set = set()
+        self._task: "asyncio.Task | None" = None
+
+    # ------------------------------------------------------------- middleware
+
+    def track(self, transport) -> None:
+        if transport is not None:
+            self._streams.add(transport)
+
+    def untrack(self, transport) -> None:
+        self._streams.discard(transport)
+
+    def remaining_s(self) -> float:
+        if not self.draining:
+            return self.grace_s
+        return max(0.0, self.started_at + self.grace_s - time.monotonic())
+
+    def retry_after_s(self) -> int:
+        """Honest Retry-After for a refused admission: the drain grace still
+        remaining — after that this process is gone and its replacement (or
+        the rest of the fleet) is the right target."""
+        return max(1, int(self.remaining_s() + 0.999))
+
+    def info(self) -> dict:
+        return {
+            "draining": self.draining,
+            "grace_s": self.grace_s,
+            "remaining_s": round(self.remaining_s(), 3),
+            "active_streams": len(self._streams),
+            "parked": self.parked,
+            "aborted_connections": self.aborted_connections,
+        }
+
+    # ------------------------------------------------------------------ drain
+
+    def start(self, grace_s: float | None = None) -> dict:
+        """Begin draining (idempotent). Returns the current drain info."""
+        if not self.draining:
+            if grace_s is not None:
+                self.grace_s = max(0.0, float(grace_s))
+            self.draining = True
+            self.started_at = time.monotonic()
+            core = self.engine.core
+            core.begin_drain()
+            core.metrics.set_drain_state(1)
+            log.info("drain started: %d in-flight stream(s), grace %.1fs",
+                     len(self._streams), self.grace_s)
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="engine-drain"
+            )
+        return self.info()
+
+    async def wait(self) -> None:
+        if self._task is not None:
+            await self._task
+
+    async def _run(self) -> None:
+        core = self.engine.core
+        deadline = self.started_at + self.grace_s
+        while time.monotonic() < deadline:
+            if not self._streams and core.stats().active_slots == 0:
+                log.info("drain complete: all in-flight work finished "
+                         "within the grace")
+                return
+            await asyncio.sleep(0.05)
+        # Grace spent: park what is still decoding (the step loop executes
+        # the parks — slot state is loop-thread-owned) so the committed
+        # tokens are accounted, then hard-abort the surviving connections.
+        # The gateway sees each abort as a mid-stream cut and resumes the
+        # stream on another engine from its own replay ledger.
+        before = core.metrics.drain_parked_total
+        core.request_drain_park()
+        # wait for the step loop to CONSUME the park request (it may be
+        # inside a long dispatch/compile), then briefly for the parks to
+        # settle — a fixed short wait here under-reported `parked` whenever
+        # a dispatch outlived it. Bounded: a wedged loop must not stall the
+        # aborts (and the shutdown behind them) forever.
+        flag_deadline = time.monotonic() + 30.0
+        while (core._drain_park_requested
+               and time.monotonic() < flag_deadline):
+            await asyncio.sleep(0.02)
+        settle_deadline = time.monotonic() + 2.0
+        while (time.monotonic() < settle_deadline
+               and core.stats().active_slots > 0):
+            await asyncio.sleep(0.02)
+        self.parked = core.metrics.drain_parked_total - before
+        stragglers = list(self._streams)
+        for transport in stragglers:
+            try:
+                transport.abort()
+            except Exception:  # allow-silent: best-effort teardown of a
+                # transport that may already be closing under us
+                pass
+        self.aborted_connections = len(stragglers)
+        # AFTER the aborts: terminal-error everything still queued (parked
+        # work included) so the handlers blocked on those event queues
+        # unblock — their farewell frames can no longer reach a client (the
+        # sockets are gone), and the gateway resumes from its own ledger.
+        core.request_drain_flush()
+        if stragglers or self.parked:
+            log.warning(
+                "drain grace expired: parked %d slot(s), aborted %d "
+                "connection(s) for gateway-side resume",
+                self.parked, len(stragglers),
+            )
+
+
 class EngineAPI:
     def __init__(self, engine: Engine, *, asr=None, tts=None, image=None):
         self.engine = engine
@@ -210,6 +355,9 @@ class EngineAPI:
         self.image = image  # engine.image.ImageEngine | None
         # one capture at a time: the manager guards the global jax tracer
         self.profiles = ProfileManager()
+        # graceful drain (SIGTERM / POST /api/drain): admission gate +
+        # in-flight connection ledger (docs/deployment.md)
+        self.drain = DrainController(engine)
 
     # ------------------------------------------------------------- inventory
 
@@ -368,7 +516,33 @@ class EngineAPI:
         )
 
     async def health(self, request: web.Request) -> web.Response:
-        return web.json_response(self.engine.health())
+        body = self.engine.health()
+        if self.drain.draining:
+            # the gateway's health checker re-parses this on EVERY probe and
+            # flips the endpoint out of selection within one interval
+            body["status"] = "draining"
+        body["draining"] = self.drain.info()
+        return web.json_response(body)
+
+    async def drain_control(self, request: web.Request) -> web.Response:
+        """POST /api/drain — begin a graceful drain (docs/deployment.md):
+        new admissions 503 with Retry-After, in-flight decodes get the grace
+        (optional body {"grace_s": N} overrides LLMLB_DRAIN_GRACE_S), then
+        stragglers are parked and their connections closed so the gateway's
+        mid-stream resume moves them to another engine. Idempotent; poll
+        GET /api/health for progress."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        grace = body.get("grace_s")
+        if grace is not None and (isinstance(grace, bool)
+                                  or not isinstance(grace, (int, float))
+                                  or grace < 0):
+            return _error(400, "'grace_s' must be a non-negative number")
+        return web.json_response(self.drain.start(grace))
 
     async def prometheus_metrics(self, request: web.Request) -> web.Response:
         """GET /metrics — Prometheus exposition of the serving loop
@@ -406,6 +580,8 @@ class EngineAPI:
                 "sched": self.engine.core.sched_info(),
                 # disaggregated prefill/decode: role + handoff counters
                 "disagg": self.engine.core.disagg_info(),
+                # graceful drain state (docs/deployment.md)
+                "draining": self.drain.info(),
                 # live roofline: MFU / HBM-bandwidth utilization against the
                 # chip's peak specs (available only on chips in the table
                 # and once decode traffic has flowed)
@@ -603,6 +779,7 @@ class EngineAPI:
                 ),
                 request_id=rid,
                 tool_name=tool_name,
+                replay=bool(body.get("llmlb_replay")),
             )
 
         try:
@@ -618,7 +795,7 @@ class EngineAPI:
     async def _stream_chat(
         self, request, completion_id, created, model, prompt_ids, sampling, stops,
         include_usage: bool, request_id: str | None = None,
-        tool_name: str | None = None, agen=None,
+        tool_name: str | None = None, agen=None, replay: bool = False,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -662,6 +839,16 @@ class EngineAPI:
                                       request_id=request_id)
         try:
             async for delta in agen:
+                if replay and delta.token_ids:
+                    # Durable streams (docs/resilience.md): ship the newly
+                    # committed token ids as a gateway-internal frame BEFORE
+                    # the text they produced — the gateway strips these and,
+                    # on a mid-stream cut, replays them onto another engine's
+                    # /v1/resume so the continuation is token-identical.
+                    await _sse_send(resp, {
+                        "object": "llmlb.replay",
+                        "tokens": [int(t) for t in delta.token_ids],
+                    })
                 if delta.text:
                     if tool_name is not None:
                         await _sse_send(resp, chunk({"tool_calls": [{
@@ -674,8 +861,14 @@ class EngineAPI:
                     finish = delta.finish_reason
                     usage = _usage(delta.prompt_tokens, delta.completion_tokens)
         except (EngineError, ValueError) as e:
-            await _sse_send(resp, {"error": {"message": str(e)}})
-            await resp.write(b"data: [DONE]\n\n")
+            try:
+                await _sse_send(resp, {"error": {"message": str(e)}})
+                await resp.write(b"data: [DONE]\n\n")
+            except OSError:
+                # socket already gone (drain aborted it / client left): the
+                # farewell has nowhere to go, and failing loudly here would
+                # just re-raise into the access log
+                pass
             return resp
         if tool_name is not None and finish == "stop":
             finish = "tool_calls"
@@ -725,6 +918,30 @@ class EngineAPI:
             },
             headers=_rid_headers(rid),
         )
+
+    async def _collect_chat_response(self, agen, completion_id: str,
+                                     created: int, model: str,
+                                     tool_name: str | None,
+                                     rid: str | None) -> web.Response:
+        """Drain a stream generator into one chat.completion JSON — the
+        non-streaming tail shared by /v1/handoff adoption and /v1/resume."""
+        import dataclasses as _dc
+
+        text = []
+        final = None
+        try:
+            async for delta in agen:
+                text.append(delta.text)
+                if delta.finish_reason is not None:
+                    final = delta
+        except EngineError as e:
+            return _error(500, str(e), "server_error")
+        except ValueError as e:
+            return _error(400, str(e))
+        assert final is not None
+        result = _dc.replace(final, text="".join(text))
+        return self._chat_response(completion_id, created, model, result,
+                                   tool_name, rid)
 
     async def handoff_prefill(self, request: web.Request) -> web.Response:
         """POST /v1/handoff/prefill — the prefill-role half of the
@@ -826,25 +1043,60 @@ class EngineAPI:
                 request, completion_id, created, model,
                 prompt_ids, sampling, stops,
                 include_usage=True, request_id=rid, tool_name=tool_name,
-                agen=agen,
+                agen=agen, replay=bool(body.get("llmlb_replay")),
             )
-        text = []
-        final = None
+        return await self._collect_chat_response(
+            agen, completion_id, created, model, tool_name, rid
+        )
+
+    async def resume(self, request: web.Request) -> web.StreamResponse:
+        """POST /v1/resume — continue a stream another engine started, from
+        the ORIGINAL chat body plus the token ids already committed (durable
+        streams, docs/resilience.md). This engine re-encodes the prompt with
+        its own tokenizer (identical across engines serving one model),
+        replays prompt+committed as a chunk prefill (the PR 10/11 park/adopt
+        path — KV lands at identical absolute positions, greedy and seeded
+        continuations are token-identical), and streams the FULL completion
+        (committed + continuation) in the normal chat-completions shape; the
+        gateway splices off the prefix its client already holds. Unlike
+        /v1/handoff there is no wire sampling block: the chat body is the
+        contract, so any tpu:// engine can adopt regardless of role."""
         try:
-            async for delta in agen:
-                text.append(delta.text)
-                if delta.finish_reason is not None:
-                    final = delta
-        except EngineError as e:
-            return _error(500, str(e), "server_error")
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        committed = body.get("committed_ids")
+        if committed is None:
+            committed = []
+        if not isinstance(committed, list) or any(
+            isinstance(t, bool) or not isinstance(t, int) for t in committed
+        ):
+            return _error(400, "'committed_ids' must be a list of token ids")
+        try:
+            prompt_ids, sampling, stops, tool_name, model = self._parse_chat(
+                request, body
+            )
         except ValueError as e:
             return _error(400, str(e))
-        assert final is not None
-        import dataclasses as _dc
-
-        result = _dc.replace(final, text="".join(text))
-        return self._chat_response(completion_id, created, model, result,
-                                   tool_name, rid)
+        rid = _request_id_from(request)
+        agen = self.engine.adopt_stream(
+            prompt_ids, [int(t) for t in committed], sampling, stops,
+            request_id=rid,
+        )
+        completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        if body.get("stream"):
+            return await self._stream_chat(
+                request, completion_id, created, model, prompt_ids, sampling,
+                stops, include_usage=True, request_id=rid,
+                tool_name=tool_name, agen=agen,
+                replay=bool(body.get("llmlb_replay")),
+            )
+        return await self._collect_chat_response(
+            agen, completion_id, created, model, tool_name, rid
+        )
 
     # ----------------------------------------------------------- completions
 
@@ -1080,12 +1332,42 @@ async def error_middleware(request: web.Request, handler):
 
 def create_engine_app(engine: Engine, *, owns_engine: bool = True,
                       asr=None, tts=None, image=None) -> web.Application:
-    app = web.Application(client_max_size=MAX_BODY_BYTES, middlewares=[error_middleware])
     api = EngineAPI(engine, asr=asr, tts=tts, image=image)
+
+    @web.middleware
+    async def drain_middleware(request: web.Request, handler):
+        """Admission gate + in-flight ledger for graceful drain: while
+        draining, new /v1 work 503s with an honest Retry-After (the grace
+        remaining); accepted /v1 POSTs register their transport so the
+        post-grace abort can cut stragglers for gateway-side resume. Read
+        surfaces (/api/health, /metrics) always answer — the health checker
+        must be able to see the draining advertisement."""
+        if request.method == "POST" and request.path.startswith("/v1/"):
+            drain = api.drain
+            if drain.draining:
+                return web.json_response(
+                    {"error": {
+                        "message": "engine is draining; retry on another "
+                                   "endpoint",
+                        "type": "overloaded_error", "code": "draining",
+                    }},
+                    status=503,
+                    headers={"Retry-After": str(drain.retry_after_s())},
+                )
+            drain.track(request.transport)
+            try:
+                return await handler(request)
+            finally:
+                drain.untrack(request.transport)
+        return await handler(request)
+
+    app = web.Application(client_max_size=MAX_BODY_BYTES,
+                          middlewares=[error_middleware, drain_middleware])
     app.router.add_get("/v1/models", api.list_models)
     app.router.add_post("/v1/chat/completions", api.chat_completions)
     app.router.add_post("/v1/handoff", api.handoff_adopt)
     app.router.add_post("/v1/handoff/prefill", api.handoff_prefill)
+    app.router.add_post("/v1/resume", api.resume)
     app.router.add_post("/v1/completions", api.completions)
     app.router.add_post("/v1/responses", api.responses)
     app.router.add_post("/v1/embeddings", api.embeddings)
@@ -1093,6 +1375,7 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     app.router.add_post("/v1/audio/speech", api.audio_speech)
     app.router.add_post("/v1/images/generations", api.images_generations)
     app.router.add_get("/api/health", api.health)
+    app.router.add_post("/api/drain", api.drain_control)
     app.router.add_get("/metrics", api.prometheus_metrics)
     app.router.add_get("/api/system", api.system)
     app.router.add_get("/api/steps", api.steps)
@@ -1103,6 +1386,13 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
 
     if owns_engine:
         async def on_shutdown(app):
+            # Graceful path first (SIGTERM lands here through aiohttp's
+            # shutdown hooks): drain — wait the grace for in-flight decodes,
+            # park the rest, abort their connections for gateway-side
+            # resume — and only THEN tear the engine core down.
+            # engine.shutdown() is no longer the first move.
+            api.drain.start()
+            await api.drain.wait()
             engine.shutdown()
 
         app.on_shutdown.append(on_shutdown)

@@ -41,6 +41,12 @@ class StreamDelta:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     ttft_s: float | None = None
+    # Token ids newly committed since the previous delta (durable streams,
+    # docs/resilience.md): the HTTP layer ships these as gateway-internal
+    # `llmlb.replay` SSE frames when the request was armed with
+    # `llmlb_replay`, BEFORE the text they produced — so the gateway's
+    # replay ledger always covers every character the client has seen.
+    token_ids: list[int] = dataclasses.field(default_factory=list)
 
 
 class Engine:
@@ -181,7 +187,11 @@ class Engine:
         acc = ""  # decoded text; [:emitted] has been yielded
         emitted = 0
         completion_tokens = 0
+        # ids committed since the last yielded delta: they ride the NEXT
+        # delta (durable streams — the gateway's replay ledger)
+        pending_ids: list[int] = []
         ttft: float | None = None  # attached to the first yielded delta
+
         finished = False
 
         def final(text: str, reason: str) -> StreamDelta:
@@ -191,6 +201,7 @@ class Engine:
                 prompt_tokens=len(prompt_ids),
                 completion_tokens=completion_tokens,
                 ttft_s=ttft,
+                token_ids=pending_ids,
             )
 
         try:
@@ -204,6 +215,7 @@ class Engine:
                     completion_tokens += 1
                     if completion_tokens == 1 and request.first_token_at:
                         ttft = request.first_token_at - request.submitted_at
+                    pending_ids.append(int(value))
                     acc += detok.push(int(value))
                 else:  # done
                     acc += detok.flush()
@@ -220,7 +232,9 @@ class Engine:
                     return
                 boundary = max(emitted, len(acc) - holdback)
                 if boundary > emitted:
-                    delta = StreamDelta(text=acc[emitted:boundary], ttft_s=ttft)
+                    delta = StreamDelta(text=acc[emitted:boundary],
+                                        ttft_s=ttft, token_ids=pending_ids)
+                    pending_ids = []
                     ttft = None  # report once
                     emitted = boundary
                     yield delta
@@ -361,6 +375,9 @@ class Engine:
         acc = "".join(detok.push(int(t)) for t in committed_ids)
         emitted = 0
         completion_tokens = len(committed_ids)
+        # replayed ids count as committed here too: a SECOND failover from
+        # this engine must replay the full sequence (durable streams)
+        pending_ids: list[int] = [int(t) for t in committed_ids]
         ttft: float | None = None
         finished = False
 
@@ -370,6 +387,7 @@ class Engine:
                 prompt_tokens=len(prompt_ids),
                 completion_tokens=completion_tokens,
                 ttft_s=ttft,
+                token_ids=pending_ids,
             )
 
         # the wire stamp is time.time() (wall clock — the only clock two
@@ -416,6 +434,7 @@ class Engine:
                     if ttft is None and request.first_token_at:
                         ttft = (request.first_token_at
                                 - request.submitted_at)
+                    pending_ids.append(int(value))
                     acc += detok.push(int(value))
                 else:  # done
                     acc += detok.flush()
@@ -433,7 +452,8 @@ class Engine:
                 boundary = max(emitted, len(acc) - holdback)
                 if boundary > emitted:
                     delta = StreamDelta(text=acc[emitted:boundary],
-                                        ttft_s=ttft)
+                                        ttft_s=ttft, token_ids=pending_ids)
+                    pending_ids = []
                     emitted = boundary
                     yield delta
         finally:

@@ -25,6 +25,7 @@ from llmlb_tpu.gateway.api_openai import (
     HandoffOrchestrationError,
     QueueTimeout,
     StreamWriteTimeout,
+    _acquire_resume,
     _chat_prompt_text,
     _handoff_upstream,
     _record,
@@ -36,6 +37,12 @@ from llmlb_tpu.gateway.api_openai import (
     select_endpoint_with_queue,
     stream_write_guard,
     tenant_of,
+)
+from llmlb_tpu.gateway.replay import (
+    REPLAY_OBJECT,
+    RESUMABLE_ENDPOINT_TYPES,
+    ChunkSplicer,
+    ReplayState,
 )
 from llmlb_tpu.gateway.balancer import prefix_affinity_hash
 from llmlb_tpu.gateway.resilience import (
@@ -512,6 +519,21 @@ async def messages(request: web.Request) -> web.StreamResponse:
         endpoint, engine_model, lease, chosen_model = selection
         openai_body["model"] = engine_model
 
+        # Durable streams (gateway/replay.py): arm tpu:// engine streams so
+        # a mid-stream engine death resumes token-identically elsewhere and
+        # splices into the SAME Anthropic event stream (no second
+        # message_start, exactly one message_stop).
+        arm_replay = (
+            is_stream
+            and state.config.stream_resume
+            and state.config.stream_resume_attempts > 0
+            and endpoint.endpoint_type.value in RESUMABLE_ENDPOINT_TYPES
+        )
+        if arm_replay:
+            openai_body["llmlb_replay"] = True
+        else:
+            openai_body.pop("llmlb_replay", None)
+
         headers = {"Content-Type": "application/json"}
         if endpoint.api_key:
             headers["Authorization"] = f"Bearer {endpoint.api_key}"
@@ -601,9 +623,19 @@ async def messages(request: web.Request) -> web.StreamResponse:
             )
 
         if is_stream:
+            replay = None
+            if arm_replay:
+                replay = ReplayState(
+                    openai_body, capability=capability,
+                    api_kind=TpsApiKind.CHAT, tenant=tenant,
+                    weight=wfq_weight, deadline_at=deadline_at, rid=rid,
+                    prefix_hash=prefix_hash,
+                    max_attempts=state.config.stream_resume_attempts,
+                )
             result = await _stream_transform(
                 request, state, upstream, endpoint, canonical, started, lease,
                 body, openai_body, trace=trace, failover=fo, priority=prio,
+                replay=replay,
             )
             if isinstance(result, PreStreamFailure):
                 fo.record_failure(endpoint, lease, "stream_pre_byte")
@@ -666,7 +698,7 @@ async def messages(request: web.Request) -> web.StreamResponse:
 async def _stream_transform(
     request, state, upstream, endpoint, model, started, lease,
     original_body, openai_body, trace=None, failover=None,
-    priority: str = "normal",
+    priority: str = "normal", replay: ReplayState | None = None,
 ) -> "web.StreamResponse | PreStreamFailure":
     # First upstream chunk is pulled BEFORE the client response is prepared:
     # a failure there is invisible to the client and fails over.
@@ -703,6 +735,14 @@ async def _stream_transform(
     status = 200
     error = None
     upstream_failed = False
+    # durable streams: a cut booked in-line (victim charged at the moment of
+    # the cut) must not be booked again by the finally block
+    outcome_booked = False
+    splicer: ChunkSplicer | None = None  # active after the first resume
+    # set when the upstream's [DONE] has been consumed: a transport reset
+    # arriving AFTER a complete stream is not a cut (same guard as the
+    # OpenAI armed pump's terminal_sent)
+    upstream_done = False
     # Sampled token timeline + SLO inputs, same contract as the OpenAI
     # passthrough (_forward_stream): one mark per upstream data chunk that
     # produced client-visible events.
@@ -725,7 +765,7 @@ async def _stream_transform(
     resp_write = guard.write if guard.active() else resp.write
 
     async def pump(raw_chunk: bytes) -> None:
-        nonlocal buffer
+        nonlocal buffer, upstream_done
         buffer += raw_chunk
         wrote = False
         while b"\n" in buffer:
@@ -734,12 +774,30 @@ async def _stream_transform(
             if not line.startswith(b"data:"):
                 continue
             data = line[len(b"data:"):].strip()
-            if not data or data == b"[DONE]":
+            if not data:
+                continue
+            if data == b"[DONE]":
+                upstream_done = True
                 continue
             try:
                 chunk = loads(data)
             except ValueError:
                 continue
+            if replay is not None:
+                if splicer is None:
+                    # primary segment: account committed ids + chars fed to
+                    # the encoder; gateway-internal replay frames never feed
+                    if not replay.note_openai_chunk(chunk):
+                        continue
+                else:
+                    # resumed segment: the adopter re-emits the full text —
+                    # splice off what the encoder already consumed
+                    if chunk.get("object") == REPLAY_OBJECT:
+                        replay.note_openai_chunk(chunk)
+                        continue
+                    chunk = splicer.splice(chunk)
+                    if chunk is None:
+                        continue
             for event in encoder_feed(chunk):
                 await resp_write(event)
                 wrote = True
@@ -760,6 +818,36 @@ async def _stream_transform(
                     break
                 except (aiohttp.ClientError, asyncio.TimeoutError,
                         OSError) as e:
+                    if upstream_done:
+                        break  # the stream already completed cleanly
+                    if replay is not None and failover is not None:
+                        # book the victim exactly once (breaker + one
+                        # stream_interruption; also excludes it from the
+                        # re-selection) and splice a token-identical
+                        # continuation into THIS event stream — the open
+                        # encoder keeps its state, so there is no second
+                        # message_start and exactly one message_stop
+                        failover.record_failure(
+                            endpoint, None, "stream_interrupted",
+                            stream_interrupted=True,
+                        )
+                        resumed = await _acquire_resume(
+                            state, failover, replay, model, trace=trace,
+                        )
+                        if resumed is not None:
+                            upstream.release()
+                            upstream, endpoint, iterator, raw_chunk = resumed
+                            next_chunk = iterator.__anext__
+                            buffer = b""  # drop the dead stream's partials
+                            splicer = ChunkSplicer(replay)
+                            replay.mark_ledger_stale()
+                            await pump(raw_chunk)
+                            continue
+                        outcome_booked = True  # victim booked above
+                        status = 502
+                        error = f"stream interrupted: {type(e).__name__}"
+                        await resp_write(anthropic_error_event(error))
+                        break
                     # mid-stream upstream cut: native Anthropic error event,
                     # then count it against the endpoint
                     status = 502
@@ -770,7 +858,7 @@ async def _stream_transform(
                     await resp_write(anthropic_error_event(error))
                     break
                 await pump(raw_chunk)
-        if not upstream_failed:
+        if status == 200:
             for event in encoder.finish():
                 await resp_write(event)
     except asyncio.CancelledError:
@@ -798,9 +886,10 @@ async def _stream_transform(
         if trace is not None:
             trace.end("decode")
             trace.end("proxy")
-        book_stream_outcome(state, failover, endpoint, model,
-                            upstream_failed=upstream_failed,
-                            completed=status == 200)
+        if not outcome_booked:
+            book_stream_outcome(state, failover, endpoint, model,
+                                upstream_failed=upstream_failed,
+                                completed=status == 200)
         ct = encoder.usage["output_tokens"]
         duration_s = time.monotonic() - started
         if trace is not None and timeline is not None:

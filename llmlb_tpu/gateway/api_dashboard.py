@@ -291,7 +291,7 @@ async def _consume_client(ws: web.WebSocketResponse) -> None:
         async for msg in ws:
             if msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
                 break
-    except Exception:
+    except Exception:  # allow-silent: client ws died; writer side handles it
         pass
 
 

@@ -327,8 +327,8 @@ async def delete_endpoint_model(request: web.Request) -> web.Response:
 
     try:
         await sync_endpoint_models(ep, state.registry, state.http)
-    except Exception:
-        pass
+    except Exception:  # allow-silent: best-effort resync; the periodic
+        pass           # sync loop reconciles on its next pass
     return web.json_response({"deleted": model})
 
 

@@ -23,6 +23,16 @@ from aiohttp import web
 from llmlb_tpu.gateway.app_state import AppState, record_daily_stat
 from llmlb_tpu.gateway.balancer import RequestRecord, prefix_affinity_hash
 from llmlb_tpu.gateway.model_names import to_canonical, to_engine_name
+from llmlb_tpu.gateway.replay import (
+    REPLAY_OBJECT,
+    RESUMABLE_ENDPOINT_TYPES,
+    ChunkSplicer,
+    FrameSplitter,
+    ReplayState,
+    encode_chunk_frame,
+    is_done_frame,
+    parse_data_frame,
+)
 from llmlb_tpu.gateway.resilience import (
     RETRYABLE_EXCEPTIONS,
     FailoverController,
@@ -416,6 +426,9 @@ async def _handoff_upstream(
                 "stream": is_stream,
                 "model": adopt_model,
                 "tool_name": body1.get("tool_name"),
+                # durable streams: the adopted stream carries replay frames
+                # too, so a cut mid-continuation can resume elsewhere
+                "llmlb_replay": bool(payload.get("llmlb_replay")),
             },
             headers=adopt_headers, timeout=timeout,
         )
@@ -644,6 +657,25 @@ async def proxy_openai_post(
             opts["include_usage"] = True
             payload["stream_options"] = opts
 
+        # Durable streams (gateway/replay.py, docs/resilience.md): arm
+        # tpu:// engine streams with gateway-internal replay frames so a
+        # mid-stream engine death becomes a token-identical resume on
+        # another engine instead of a terminal error frame.
+        arm_replay = (
+            is_stream
+            and path == "/v1/chat/completions"
+            and state.config.stream_resume
+            and state.config.stream_resume_attempts > 0
+            and endpoint.endpoint_type.value in RESUMABLE_ENDPOINT_TYPES
+        )
+        if arm_replay:
+            payload["llmlb_replay"] = True
+        else:
+            # a client-supplied flag must not reach the engine unarmed: the
+            # byte-for-byte passthrough would forward the gateway-internal
+            # replay frames straight to the client
+            payload.pop("llmlb_replay", None)
+
         headers = {"Content-Type": "application/json"}
         if endpoint.api_key:
             headers["Authorization"] = f"Bearer {endpoint.api_key}"
@@ -760,10 +792,19 @@ async def proxy_openai_post(
 
         content_type = upstream.headers.get("Content-Type", "")
         if is_stream and "text/event-stream" in content_type:
+            replay = None
+            if arm_replay:
+                replay = ReplayState(
+                    payload, capability=capability, api_kind=api_kind,
+                    tenant=tenant, weight=wfq_weight,
+                    deadline_at=deadline_at, rid=rid,
+                    prefix_hash=prefix_hash,
+                    max_attempts=state.config.stream_resume_attempts,
+                )
             result = await _forward_stream(
                 request, state, upstream, endpoint, canonical, api_kind, path,
                 started, lease, prompt_text, client_ip, auth, stored_body,
-                trace=trace, failover=fo, priority=prio,
+                trace=trace, failover=fo, priority=prio, replay=replay,
             )
             if isinstance(result, PreStreamFailure):
                 fo.record_failure(endpoint, lease, "stream_pre_byte")
@@ -954,11 +995,141 @@ def stream_write_guard(state: AppState, resp, endpoint,
                             stall_rules)
 
 
+async def _acquire_resume(
+    state: AppState, fo: FailoverController, replay: ReplayState, model: str,
+    trace=None,
+):
+    """Open a token-identical continuation stream for a cut armed stream
+    (docs/resilience.md "mid-stream recovery"): re-run endpoint selection
+    excluding every endpoint that already failed this request, POST the
+    ORIGINAL chat body + the committed token ids to the new engine's
+    /v1/resume, and pull its first chunk. Returns ``(upstream, endpoint,
+    iterator, first_chunk)`` on success, or None when the gateway must give
+    up and emit the terminal error frame instead — attempts capped by
+    LLMLB_STREAM_RESUME_ATTEMPTS, each attempt spending the shared retry
+    budget, each outcome counted in stream_resumes_total{outcome}."""
+    timeout = aiohttp.ClientTimeout(
+        total=state.config.inference_timeout_s, sock_connect=10
+    )
+    while True:
+        if replay.attempts >= replay.max_attempts:
+            state.metrics.record_stream_resume("exhausted")
+            return None
+        if (replay.deadline_at is not None
+                and time.monotonic() >= replay.deadline_at):
+            state.metrics.record_stream_resume("exhausted")
+            return None
+        try:
+            selection = await select_endpoint_with_queue(
+                state, model, replay.capability, replay.api_kind, trace=trace,
+                prefix_hash=replay.prefix_hash, exclude=fo.failed_ids,
+                queue_timeout_s=fo.config.failover_queue_timeout_s,
+                tenant=replay.tenant, weight=replay.weight,
+                prefill_heavy=False,
+            )
+        except QueueTimeout:
+            state.metrics.record_stream_resume("no_endpoint")
+            return None
+        if selection is None:
+            state.metrics.record_stream_resume("no_endpoint")
+            return None
+        endpoint, engine_model, lease, _rec = selection
+        if endpoint.endpoint_type.value not in RESUMABLE_ENDPOINT_TYPES:
+            # a live candidate that simply does not speak /v1/resume: not a
+            # failure (no breaker, no interruption counters) — just not a
+            # resume target for this stream
+            lease.fail()
+            fo.failed_ids.add(endpoint.id)
+            continue
+        resilience = state.resilience
+        if resilience is not None and not resilience.budget.try_spend():
+            lease.fail()
+            state.metrics.record_retry_budget_exhausted()
+            state.metrics.record_stream_resume("budget")
+            return None
+        replay.attempts += 1
+        headers = {"Content-Type": "application/json"}
+        if endpoint.api_key:
+            headers["Authorization"] = f"Bearer {endpoint.api_key}"
+        if replay.rid:
+            headers[REQUEST_ID_HEADER] = replay.rid
+        if replay.deadline_at is not None:
+            remaining_ms = (replay.deadline_at - time.monotonic()) * 1000.0
+            headers["X-Request-Deadline-Ms"] = str(max(1, int(remaining_ms)))
+        try:
+            resumed = await upstream_post(
+                state, endpoint, "/v1/resume",
+                json=replay.resume_body(engine_model),
+                headers=headers, timeout=timeout,
+            )
+        except RETRYABLE_EXCEPTIONS as e:
+            reason = ("timeout" if isinstance(e, asyncio.TimeoutError)
+                      else "connect_error")
+            fo.record_failure(endpoint, lease, reason)
+            continue
+        if resumed.status != 200:
+            status_code = resumed.status
+            resumed.release()
+            if status_code in fo.config.retryable_statuses:
+                fo.record_failure(endpoint, lease, f"http_{status_code}")
+                continue
+            # the engine answered (e.g. an old build 404ing /v1/resume):
+            # alive, but this stream cannot resume there
+            lease.fail()
+            fo.record_alive(endpoint)
+            state.metrics.record_stream_resume("failed")
+            return None
+        iterator = resumed.content.iter_any()
+        try:
+            first_chunk = await iterator.__anext__()
+        except StopAsyncIteration:
+            resumed.release()
+            fo.record_failure(endpoint, lease, "stream_pre_byte")
+            continue
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                ConnectionResetError):
+            resumed.release()
+            fo.record_failure(endpoint, lease, "stream_pre_byte")
+            continue
+        lease.complete()  # stream accepted; active slot released, as ever
+        replay.resumes += 1
+        state.metrics.record_stream_resume("success")
+        state.metrics.record_stream_resumed_tokens(model,
+                                                   len(replay.committed))
+        if trace is not None:
+            trace.mark("stream_resume", endpoint=endpoint.name,
+                       committed_tokens=len(replay.committed))
+        return resumed, endpoint, iterator, first_chunk
+
+
+def _replay_frame_out(replay: ReplayState, splicer: "ChunkSplicer | None",
+                      frame: bytes) -> bytes | None:
+    """One complete upstream SSE frame → the bytes to forward to the client
+    (None = gateway-internal or fully duplicated, drop it). Before the first
+    resume (`splicer` is None) client frames pass through byte-verbatim and
+    are only ACCOUNTED; after a resume every chunk is spliced."""
+    obj = parse_data_frame(frame)
+    if obj is None:
+        return frame  # [DONE], comments, blank keep-alives: forward as-is
+    if "error" in obj:
+        # engine-side terminal error frames pass through untouched in both
+        # modes — they are client-facing, not duplicated content
+        if splicer is not None and obj.get("object") != REPLAY_OBJECT:
+            return frame
+    if splicer is None:
+        return frame if replay.note_openai_chunk(obj) else None
+    if obj.get("object") == REPLAY_OBJECT:
+        replay.note_openai_chunk(obj)  # extends the committed ledger only
+        return None
+    spliced = splicer.splice(obj)
+    return encode_chunk_frame(spliced) if spliced is not None else None
+
+
 async def _forward_stream(
     request, state: AppState, upstream, endpoint, model, api_kind, path,
     started, lease, prompt_text, client_ip, auth, stored_body=None,
     trace=None, failover: FailoverController | None = None,
-    priority: str = "normal",
+    priority: str = "normal", replay: ReplayState | None = None,
 ) -> "web.StreamResponse | PreStreamFailure":
     """Byte-for-byte SSE passthrough with token accounting (api/proxy.rs:120).
 
@@ -1006,6 +1177,10 @@ async def _forward_stream(
     status = 200
     error = None
     upstream_failed = False
+    # Durable streams: once a cut's outcome has been booked in-line (victim
+    # breaker + interruption counters at the moment of the cut), the finally
+    # block must not book anything for it again.
+    outcome_booked = False
     try:
         if first_chunk is not None:
             observe_first_token(state, trace, model, endpoint.name,
@@ -1020,31 +1195,89 @@ async def _forward_stream(
             # guarded write adds two timestamp stores per chunk (the
             # watchdog timer is per-stream, never per-chunk).
             write = guard.write if guard.active() else resp.write
-            feed(first_chunk)
-            await write(first_chunk)
-            if timeline is not None and b"data:" in first_chunk:
-                timeline.mark()
             next_chunk = iterator.__anext__
-            while True:
-                try:
-                    chunk = await next_chunk()
-                except StopAsyncIteration:
-                    break
-                except (aiohttp.ClientError, asyncio.TimeoutError,
-                        OSError) as e:
-                    # mid-stream upstream cut: tell the client, then count
-                    # it against the endpoint
-                    status = 502
-                    error = f"stream interrupted: {type(e).__name__}"
-                    upstream_failed = True
-                    # guarded: a stalled client must not pin the handler on
-                    # the farewell frame either
-                    await write(sse_error_frame(error))
-                    break
-                feed(chunk)
-                await write(chunk)
-                if timeline is not None and b"data:" in chunk:
+            if replay is None:
+                feed(first_chunk)
+                await write(first_chunk)
+                if timeline is not None and b"data:" in first_chunk:
                     timeline.mark()
+                while True:
+                    try:
+                        chunk = await next_chunk()
+                    except StopAsyncIteration:
+                        break
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        # mid-stream upstream cut: tell the client, then
+                        # count it against the endpoint
+                        status = 502
+                        error = f"stream interrupted: {type(e).__name__}"
+                        upstream_failed = True
+                        # guarded: a stalled client must not pin the handler
+                        # on the farewell frame either
+                        await write(sse_error_frame(error))
+                        break
+                    feed(chunk)
+                    await write(chunk)
+                    if timeline is not None and b"data:" in chunk:
+                        timeline.mark()
+            else:
+                # Armed (resumable) pump: frames forward whole (a cut never
+                # leaks a partial event), gateway-internal llmlb.replay
+                # frames feed the committed-token ledger, and a mid-stream
+                # cut books the dead endpoint once then splices a
+                # token-identical continuation from another engine into
+                # THIS response (docs/resilience.md "mid-stream recovery").
+                splitter = FrameSplitter()
+                splicer: ChunkSplicer | None = None
+                chunk = first_chunk
+                terminal_sent = False
+                while True:
+                    for frame in splitter.push(chunk):
+                        out = _replay_frame_out(replay, splicer, frame)
+                        if out is None:
+                            continue
+                        feed(out)
+                        await write(out)
+                        if is_done_frame(out):
+                            terminal_sent = True
+                        if timeline is not None and b"data:" in out:
+                            timeline.mark()
+                    try:
+                        chunk = await next_chunk()
+                    except StopAsyncIteration:
+                        break
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        if terminal_sent:
+                            break  # the stream already completed cleanly
+                        # book the victim exactly once: breaker failure +
+                        # per-endpoint stats + one stream_interruption, and
+                        # exclusion from the re-selection below (a resume
+                        # must never burn a half-open probe on the victim)
+                        failover.record_failure(
+                            endpoint, None, "stream_interrupted",
+                            stream_interrupted=True,
+                        )
+                        resumed = await _acquire_resume(
+                            state, failover, replay, model, trace=trace,
+                        )
+                        if resumed is None:
+                            status = 502
+                            error = (f"stream interrupted: "
+                                     f"{type(e).__name__}")
+                            outcome_booked = True  # victim booked above
+                            await write(sse_error_frame(error))
+                            break
+                        upstream.release()
+                        upstream, endpoint, iterator, chunk = resumed
+                        next_chunk = iterator.__anext__
+                        # snapshot the forwarded offsets BEFORE resetting
+                        # the ledger: the adopter re-reports the full
+                        # committed sequence for a possible second cut
+                        splitter = FrameSplitter()
+                        splicer = ChunkSplicer(replay)
+                        replay.mark_ledger_stale()
     except asyncio.CancelledError:
         # the watchdog's cancel can land at any await once it fires (e.g.
         # the next upstream read, if the write completed in the race) —
@@ -1075,10 +1308,13 @@ async def _forward_stream(
             trace.end("proxy")
         # lease already completed at stream start; this books the breaker +
         # balancer stats + interruption metric (and resolves a half-open
-        # probe even when the CLIENT was the one that went away)
-        book_stream_outcome(state, failover, endpoint, model,
-                            upstream_failed=upstream_failed,
-                            completed=status == 200)
+        # probe even when the CLIENT was the one that went away). A cut
+        # whose outcome was already booked in-line (armed pump: the victim
+        # was charged at the moment of the cut) books nothing further here.
+        if not outcome_booked:
+            book_stream_outcome(state, failover, endpoint, model,
+                                upstream_failed=upstream_failed,
+                                completed=status == 200)
         pt, ct, reported = acc.finalize(prompt_text)
         duration_s = time.monotonic() - started
         if trace is not None and timeline is not None:

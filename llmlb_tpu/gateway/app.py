@@ -536,6 +536,10 @@ async def _api_health(request: web.Request) -> web.Response:
             "status": ep.status.value,
             # serving role from the last engine probe (docs/disaggregation.md)
             "role": ep.accelerator.role or "both",
+            # graceful-drain advertisement from the last probe: a draining
+            # engine is online but ejected from selection
+            # (docs/deployment.md)
+            "draining": ep.accelerator.draining,
             "breaker": breaker,
             "latency_ms": ep.latency_ms,
             "consecutive_probe_failures": ep.consecutive_failures,
@@ -545,7 +549,8 @@ async def _api_health(request: web.Request) -> web.Response:
     online = sum(1 for e in endpoints if e["status"] == "online")
     serving = sum(
         1 for e in endpoints
-        if e["status"] == "online" and e["breaker"]["state"] != "open"
+        if (e["status"] == "online" and e["breaker"]["state"] != "open"
+            and not e["draining"])
     )
     body = {
         "status": "ok" if serving or not endpoints else "degraded",

@@ -212,7 +212,7 @@ class AppState:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
-                pass
+                pass  # allow-silent: shutdown teardown of cancelled tasks
         if self.health_checker:
             await self.health_checker.stop()
         if self.history is not None:

@@ -65,8 +65,8 @@ def batch_hash(prev_hash: str, entries: list[AuditEntry]) -> str:
         digest = native_chain_hash(prev_hash, canon)
         if digest is not None:
             return digest
-    except Exception:  # native lib unavailable/broken: identical Python path
-        pass
+    except Exception:  # allow-silent: native lib unavailable/broken —
+        pass               # the identical Python path below serves
     h = hashlib.sha256()
     h.update(prev_hash.encode())
     for c in canon:

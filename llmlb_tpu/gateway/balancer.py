@@ -572,12 +572,17 @@ class LoadManager:
     # -------------------------------------------------------------- selection
 
     def _permitted(self, endpoints: list[Endpoint]) -> list[Endpoint]:
-        """Drop endpoints whose circuit breaker refuses traffic right now.
+        """Drop endpoints whose circuit breaker refuses traffic right now,
+        and endpoints whose last health probe advertised a graceful drain
+        (docs/deployment.md) — both reduce the candidate set, never the 404
+        decision: a model whose endpoints are all ejected queues and 503s.
         No resilience manager wired (unit tests, resilience disabled) means
-        no filtering."""
+        no breaker filtering; the drain filter always applies."""
+        out = [ep for ep in endpoints
+               if ep.accelerator is None or not ep.accelerator.draining]
         if self.resilience is None:
-            return endpoints
-        return [ep for ep in endpoints if self.resilience.allow(ep.id)]
+            return out
+        return [ep for ep in out if self.resilience.allow(ep.id)]
 
     def _note_admitted(self, endpoint_id: str) -> None:
         if self.resilience is not None:
@@ -770,8 +775,8 @@ class LoadManager:
         if cb is not None:
             try:
                 cb(endpoint_id)
-            except Exception:  # a broken listener must not poison releases
-                pass
+            except Exception:  # allow-silent: a broken listener must
+                pass               # not poison releases
 
     def active_count(self, endpoint_id: str) -> int:
         if self._rc is not None:

@@ -295,6 +295,13 @@ class ServerConfig:
     # Default request deadline in ms applied when the client sends none
     # (X-Request-Deadline-Ms header wins). 0 = no default deadline.
     request_deadline_ms: float = 0.0
+    # Durable streams (gateway/replay.py, docs/resilience.md): when a tpu://
+    # engine dies mid-stream, replay prompt+committed tokens onto another
+    # engine and splice the token-identical continuation into the SAME
+    # client response instead of emitting a terminal error frame.
+    stream_resume: bool = True
+    # Resume attempts per stream (each also spends the global retry budget).
+    stream_resume_attempts: int = 2
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -322,4 +329,8 @@ class ServerConfig:
                 "LLMLB_STREAM_WRITE_TIMEOUT", 30.0
             ),
             request_deadline_ms=env_float("LLMLB_REQUEST_DEADLINE_MS", 0.0),
+            stream_resume=env_bool("LLMLB_STREAM_RESUME", True),
+            stream_resume_attempts=max(
+                0, env_int("LLMLB_STREAM_RESUME_ATTEMPTS", 2)
+            ),
         )

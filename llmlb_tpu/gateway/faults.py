@@ -30,14 +30,15 @@ import threading
 import aiohttp
 
 VALID_KINDS = ("connect_refused", "latency", "http", "stream_cut",
-               "stalled_reader")
+               "engine_abort", "stalled_reader")
 
 # Kinds applied at the upstream POST boundary (resilience.upstream_post);
 # stalled_reader is applied in the CLIENT-side stream pump instead — it
 # simulates a reader that stops draining the SSE stream (after_bytes sets
 # the stall point, latency_ms the stall duration), which the pump's write
 # timeout must catch (docs/scheduling.md slow-loris protection).
-UPSTREAM_KINDS = ("connect_refused", "latency", "http", "stream_cut")
+UPSTREAM_KINDS = ("connect_refused", "latency", "http", "stream_cut",
+                  "engine_abort")
 
 
 @dataclasses.dataclass
@@ -131,6 +132,58 @@ class _CutContent:
                 )
             self._budget -= len(chunk)
             yield chunk
+
+
+class _AbortContent:
+    """Async-iterates the inner response content, raising ConnectionResetError
+    once `after_bytes` whole chunks have been delivered — the SIGKILLed-engine
+    signature: the socket resets cleanly between frames, with NO partial event
+    and NO prior error frame. Distinct from `_CutContent`, which delivers a
+    truncated partial chunk first (a cut that can land mid-line): with
+    `after_bytes` aligned to a frame boundary this rule reproduces exactly
+    what a killed engine process looks like to the proxy, so the mid-stream
+    resume path is unit-testable without forking processes."""
+
+    def __init__(self, inner, after_bytes: int):
+        self._inner = inner
+        self._budget = after_bytes
+
+    async def iter_any(self):
+        async for chunk in self._inner.iter_any():
+            if len(chunk) > self._budget:
+                raise ConnectionResetError(
+                    "fault injected: engine abort"
+                )
+            self._budget -= len(chunk)
+            yield chunk
+        if self._budget > 0:
+            # the stream ended before the abort point: reset at EOF anyway —
+            # the rule promised a reset, and a silently clean end would make
+            # a mis-sized test pass for the wrong reason
+            raise ConnectionResetError("fault injected: engine abort at EOF")
+
+
+class EngineAbortResponse:
+    """Wraps a real upstream response so its connection resets after K
+    delivered bytes with no prior error frame (kind="engine_abort")."""
+
+    def __init__(self, inner, after_bytes: int):
+        self._inner = inner
+        self.content = _AbortContent(inner.content, after_bytes)
+
+    @property
+    def status(self) -> int:
+        return self._inner.status
+
+    @property
+    def headers(self):
+        return self._inner.headers
+
+    async def read(self) -> bytes:
+        raise ConnectionResetError("fault injected: engine abort")
+
+    def release(self) -> None:
+        self._inner.release()
 
 
 class StreamCutResponse:
@@ -232,6 +285,7 @@ class FaultInjector:
                                    else None),
                     "after_bytes": (r.after_bytes
                                     if r.kind in ("stream_cut",
+                                                  "engine_abort",
                                                   "stalled_reader")
                                     else None),
                     "seen": r.seen, "fires": r.fires,

@@ -58,8 +58,21 @@ def _parse_telemetry(body: dict) -> AcceleratorInfo:
     disagg = body.get("disagg")
     disagg = disagg if isinstance(disagg, dict) else {}
     role = disagg.get("role")
+    # Graceful drain advertisement (docs/deployment.md): a draining engine
+    # keeps answering probes with 200 (so its models never 404) but flags
+    # itself here — selection drops it within one probe interval.
+    drain = body.get("draining")
+    drain = drain if isinstance(drain, dict) else {}
+    draining = (body.get("status") == "draining"
+                or bool(drain.get("draining")))
+    try:
+        drain_remaining = max(0.0, float(drain.get("remaining_s") or 0.0))
+    except (TypeError, ValueError):
+        drain_remaining = 0.0
     return AcceleratorInfo(
         role=role if role in ROLES else None,
+        draining=draining,
+        drain_remaining_s=drain_remaining,
         accelerator=tpu.get("accelerator") or ("tpu" if "tpu" in body else None),
         chip_count=_as_int(tpu.get("chip_count")),
         hbm_used_bytes=_as_int(tpu.get("hbm_used_bytes")),
@@ -252,8 +265,8 @@ class EndpointHealthChecker:
                 )
                 self.registry.update_type(ep.id, detected)
                 ep.endpoint_type = detected
-        except Exception:
-            pass
+        except Exception:  # allow-silent: re-detection is opportunistic;
+            pass           # the model resync below still runs and logs
         try:
             await sync_endpoint_models(ep, self.registry, self.session)
         except Exception as e:

@@ -95,7 +95,7 @@ def init_logging(
             root.removeHandler(h)
             try:
                 h.close()
-            except Exception:
+            except Exception:  # allow-silent: closing a dead log sink
                 pass
 
     stderr = logging.StreamHandler()

@@ -150,6 +150,15 @@ class GatewayMetrics:
         self._ratelimit_rejections: dict[str, int] = defaultdict(int)
         self._deadline_shed: dict[str, int] = defaultdict(int)
         self._stream_write_timeouts: dict[str, int] = defaultdict(int)
+        # durable streams (gateway/replay.py, docs/resilience.md): mid-stream
+        # cuts replayed onto another engine, by outcome — "success" (the
+        # continuation spliced into the client stream), or why the gateway
+        # gave up and emitted the terminal error frame instead ("exhausted"
+        # attempts, "budget" refused, "no_endpoint", "failed" resume POST)
+        self._stream_resumes: dict[str, int] = defaultdict(int)
+        # committed tokens replayed onto the resuming engine (the work the
+        # failover saved the client from losing)
+        self._stream_resumed_tokens: dict[str, int] = defaultdict(int)
 
     # ------------------------------------------------------------ recorders
 
@@ -249,6 +258,20 @@ class GatewayMetrics:
         with self._lock:
             self._stream_write_timeouts[model] += 1
 
+    def record_stream_resume(self, outcome: str) -> None:
+        """One mid-stream resume attempt resolved; outcome is "success"
+        (continuation spliced) or the give-up reason (exhausted / budget /
+        no_endpoint / failed)."""
+        with self._lock:
+            self._stream_resumes[outcome] += 1
+
+    def record_stream_resumed_tokens(self, model: str, n: int) -> None:
+        """Committed tokens replayed onto the resuming engine."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._stream_resumed_tokens[model] += n
+
     def record_slo(self, model: str, ttft_s: float | None,
                    itl_mean_s: float | None,
                    priority: str | None = None) -> None:
@@ -338,6 +361,9 @@ class GatewayMetrics:
                 "deadline_shed_total": sum(self._deadline_shed.values()),
                 "stream_write_timeouts_total":
                     sum(self._stream_write_timeouts.values()),
+                "stream_resumes": dict(self._stream_resumes),
+                "stream_resumed_tokens_total":
+                    sum(self._stream_resumed_tokens.values()),
                 "goodput_by_priority": {
                     prio: round(self._slo_prio_met.get(prio, 0) / n, 4)
                     for prio, n in self._slo_prio_eligible.items() if n
@@ -515,6 +541,22 @@ class GatewayMetrics:
             for model, n in sorted(self._stream_write_timeouts.items()):
                 lines.append(
                     f'llmlb_gateway_stream_write_timeouts_total'
+                    f'{{model="{_escape(model)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_stream_resumes_total counter"
+            )
+            for outcome, n in sorted(self._stream_resumes.items()):
+                lines.append(
+                    f'llmlb_gateway_stream_resumes_total'
+                    f'{{outcome="{_escape(outcome)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_stream_resumed_tokens_total counter"
+            )
+            for model, n in sorted(self._stream_resumed_tokens.items()):
+                lines.append(
+                    f'llmlb_gateway_stream_resumed_tokens_total'
                     f'{{model="{_escape(model)}"}} {n}'
                 )
             for name, table in (

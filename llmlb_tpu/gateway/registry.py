@@ -52,8 +52,8 @@ class EndpointRegistry:
         if cb is not None:
             try:
                 cb()
-            except Exception:
-                pass
+            except Exception:  # allow-silent: a broken listener must not
+                pass           # poison registry mutations
 
     # ------------------------------------------------------------------ CRUD
 

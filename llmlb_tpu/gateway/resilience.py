@@ -46,6 +46,7 @@ import aiohttp
 
 from llmlb_tpu.gateway.config import ResilienceConfig
 from llmlb_tpu.gateway.faults import (
+    EngineAbortResponse,
     InjectedHTTPResponse,
     StreamCutResponse,
 )
@@ -151,7 +152,7 @@ class RetryBudget:
         if cb is not None:
             try:
                 cb()
-            except Exception:
+            except Exception:  # allow-silent: gossip publish is best-effort
                 pass
         return True
 
@@ -619,6 +620,7 @@ async def upstream_post(state, endpoint, path: str, *, json=None, data=None,
     fired = (faults.decide(endpoint, path, kinds=UPSTREAM_KINDS)
              if faults is not None else ())
     cut_rule = None
+    abort_rule = None
     for rule in fired:
         state.metrics.record_fault_injected(rule.kind)
         if rule.kind == "latency" and rule.latency_ms > 0:
@@ -631,10 +633,17 @@ async def upstream_post(state, endpoint, path: str, *, json=None, data=None,
             return InjectedHTTPResponse(rule.status)
         elif rule.kind == "stream_cut":
             cut_rule = rule
+        elif rule.kind == "engine_abort":
+            abort_rule = rule
     resp = await state.http.post(
         endpoint.url + path, json=json, data=data, headers=headers,
         timeout=timeout,
     )
+    if abort_rule is not None:
+        # connection reset after K delivered bytes, no partial event, no
+        # prior error frame — the killed-engine signature the mid-stream
+        # resume path recovers from (docs/resilience.md)
+        return EngineAbortResponse(resp, abort_rule.after_bytes)
     if cut_rule is not None:
         return StreamCutResponse(resp, cut_rule.after_bytes)
     return resp
@@ -646,13 +655,21 @@ async def upstream_post(state, endpoint, path: str, *, json=None, data=None,
 def retry_after_seconds(state, model: str | None,
                         capability=None) -> int:
     """Retry-After for a 503: if every endpoint serving the model is
+    draining, the soonest drain completion (a replacement engine should be
+    registering about then — docs/deployment.md); if every endpoint is
     breaker-open, the soonest breaker reopen; otherwise a fraction of the
     queue timeout (capacity should free up well before a full timeout)."""
     resilience = state.resilience
-    if model and resilience is not None:
+    if model:
         pairs = state.registry.find_by_model(model, capability)
-        if pairs:
-            wait = resilience.soonest_reopen_s([ep.id for ep, _ in pairs])
+        eps = [ep for ep, _ in pairs]
+        draining = [ep for ep in eps
+                    if ep.accelerator is not None and ep.accelerator.draining]
+        if eps and len(draining) == len(eps):
+            wait = min(ep.accelerator.drain_remaining_s for ep in draining)
+            return max(1, min(60, math.ceil(wait)))
+        if eps and resilience is not None:
+            wait = resilience.soonest_reopen_s([ep.id for ep in eps])
             if wait is not None:
                 return max(1, math.ceil(wait))
     queue_timeout = state.load_manager.queue_config.queue_timeout_s

@@ -192,8 +192,8 @@ class TrayController:
             try:
                 await self._task
             except (asyncio.CancelledError, Exception):
-                # a pump that died earlier must not abort the server's
-                # shutdown sequence (drain + update-manager stop follow us)
+                # allow-silent: a pump that died earlier must not abort the
+                # server's shutdown sequence (drain + update stop follow us)
                 pass
             self._task = None
         if self.events is not None and self._sub_id is not None:

@@ -104,6 +104,13 @@ class AcceleratorInfo:
     # Re-parsed on every probe, so a restarted engine whose role changed
     # re-routes within one probe interval.
     role: str | None = None
+    # Graceful drain advertisement (docs/deployment.md): a draining engine
+    # still answers probes (status stays online — its models must not 404)
+    # but is ejected from selection (balancer._permitted) within one probe
+    # interval; `drain_remaining_s` feeds the gateway's Retry-After when
+    # every endpoint for a model is draining.
+    draining: bool = False
+    drain_remaining_s: float = 0.0
     sampled_at: float = 0.0  # when the probe captured this; 0 = never
 
     @property

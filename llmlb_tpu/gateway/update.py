@@ -387,7 +387,7 @@ class UpdateManager:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
-                pass
+                pass  # allow-silent: shutdown teardown of cancelled tasks
         self._bg_tasks.clear()
 
     async def _check_loop(self, interval_s: float) -> None:

@@ -2183,6 +2183,323 @@ async def run_chaos_bench(seconds: float, concurrency: int) -> dict:
         await gw.close()
 
 
+# ------------------------------------------------- engine-kill chaos drill
+
+
+def _spawn_engine_process(port: int, *, extra_env: dict | None = None):
+    """A REAL tpu:// engine server process (CPU backend, debug-tiny preset,
+    seed-0 weights — every instance generates identical tokens), ready to
+    be SIGKILLed/SIGTERMed like production."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "LLMLB_NATIVE_ROUTER": "0"})
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "llmlb_tpu.engine.server",
+         "--preset", "debug-tiny", "--host", "127.0.0.1",
+         "--port", str(port), "--num-slots", "16",
+         "--slot-capacity", "2048", "--prefill-buckets", "16,32",
+         "--kv-page-size", "16"],
+        env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+async def _wait_engine_up(session, port: int, timeout_s: float = 120.0):
+    import aiohttp
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            async with session.get(
+                f"http://127.0.0.1:{port}/v1/models",
+                timeout=aiohttp.ClientTimeout(total=2.0),
+            ) as resp:
+                if resp.status == 200:
+                    return
+        except Exception:
+            pass
+        await asyncio.sleep(0.25)
+    raise RuntimeError(f"engine on port {port} never came up")
+
+
+async def run_chaos_engine_kill(streams: int = 8,
+                                drills: tuple = ("kill", "drain")) -> dict:
+    """The durable-streams chaos drill (docs/resilience.md): REAL engine
+    processes behind the real gateway, N streams mid-generation, then
+
+    - ``kill``: SIGKILL one engine — every cut stream must resume
+      token-identically on the survivor and complete (>=99% client
+      success, completed streams byte-equal to an undisturbed baseline);
+    - ``drain``: SIGTERM one engine with a short LLMLB_DRAIN_GRACE_S —
+      every in-flight stream either finishes inside the grace or is
+      parked + cut for gateway-side resume; ZERO client-visible errors.
+
+    Greedy and seeded-stochastic streams both run (token identity holds
+    for both: seed-0 weights, per-request seeds folded by absolute
+    position). Exit code 1 when any bar is missed.
+    """
+    import signal
+
+    from llmlb_tpu.gateway.config import ResilienceConfig
+    from llmlb_tpu.gateway.faults import FaultInjector
+    from llmlb_tpu.gateway.resilience import ResilienceManager
+    from llmlb_tpu.gateway.types import EndpointStatus, EndpointType
+    from tests.support import GatewayHarness, assert_sse_protocol
+
+    t_start = time.monotonic()
+    gw = await GatewayHarness.create()
+    procs: list = []
+    result: dict = {
+        "metric": "chaos_engine_kill_drill",
+        "unit": "fraction",
+        "streams": streams,
+        "drills": {},
+    }
+
+    def spawn(extra_env=None):
+        port = _free_port()
+        proc = _spawn_engine_process(port, extra_env=extra_env)
+        procs.append(proc)
+        return port, proc
+
+    try:
+        manager = ResilienceManager(
+            ResilienceConfig(
+                breaker_failure_threshold=3, breaker_open_s=0.5,
+                breaker_open_max_s=2.0, backoff_base_s=0.005,
+                backoff_cap_s=0.05, failover_queue_timeout_s=5.0,
+                # the drill cuts ~all streams at once against near-zero
+                # request volume, so the ratio term is 0 and the FLOOR is
+                # the whole budget — size it for the drill (production
+                # budgets scale with real traffic)
+                retry_budget_min=4 * streams,
+            ),
+            metrics=gw.state.metrics, events=gw.state.events,
+            registry=gw.state.registry,
+        )
+        gw.state.resilience = manager
+        gw.state.load_manager.resilience = manager
+        gw.state.faults = FaultInjector()
+        headers = dict(await gw.inference_headers())
+        headers["Content-Type"] = "application/json"
+
+        port_a, proc_a = spawn()
+        port_b, proc_b = spawn({"LLMLB_DRAIN_GRACE_S": "0.8"})
+        await _wait_engine_up(gw.state.http, port_a)
+        await _wait_engine_up(gw.state.http, port_b)
+        ep_a = gw.register_mock(f"http://127.0.0.1:{port_a}", ["debug-tiny"],
+                                endpoint_type=EndpointType.TPU, name="eng-a")
+        ep_b = gw.register_mock(f"http://127.0.0.1:{port_b}", ["debug-tiny"],
+                                endpoint_type=EndpointType.TPU, name="eng-b")
+
+        contents: list[str] = [""] * streams
+
+        def body_for(i: int, stream: bool, content: str | None = None,
+                     max_tokens: int = 160) -> dict:
+            body = {
+                "model": "debug-tiny",
+                "messages": [{"role": "user",
+                              "content": content or contents[i]}],
+                "max_tokens": max_tokens,
+                "stream": stream,
+            }
+            if i % 2 == 0:
+                body["temperature"] = 0.0  # greedy half
+            else:
+                body["temperature"] = 0.9
+                body["seed"] = 1000 + i
+            return body
+
+        # ---- undisturbed baseline: non-streaming completions (the engine
+        # collects the same stream internally, so text == stream text).
+        # Prompt variants are probed for no-early-EOS (>=120 tokens) so the
+        # kill reliably lands while streams are still decoding — the same
+        # trick the PR 10 slo-mix bench uses.
+        async def baseline(i: int) -> str:
+            text = ""
+            for j in range(12):
+                content = f"chaos stream {i}.{j} lorem ipsum dolor"
+                r = await gw.client.post(
+                    "/v1/chat/completions",
+                    json=body_for(i, stream=False, content=content),
+                    headers=headers,
+                )
+                assert r.status == 200, await r.text()
+                out = await r.json()
+                text = out["choices"][0]["message"]["content"]
+                contents[i] = content
+                if out["usage"]["completion_tokens"] >= 120:
+                    break
+            return text
+
+        baselines = list(await asyncio.gather(
+            *(baseline(i) for i in range(streams))
+        ))
+
+        def stream_text(raw: bytes) -> str:
+            parts = []
+            for line in raw.split(b"\n"):
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[len(b"data:"):].strip()
+                if not data or data == b"[DONE]":
+                    continue
+                try:
+                    obj = json.loads(data)
+                except ValueError:
+                    continue
+                for choice in obj.get("choices") or []:
+                    c = (choice.get("delta") or {}).get("content")
+                    if isinstance(c, str):
+                        parts.append(c)
+            return "".join(parts)
+
+        async def one_stream(i: int, first_byte_evt: asyncio.Event,
+                             counter: list) -> dict:
+            out = {"ok": False, "identical": False, "error": None}
+            try:
+                r = await gw.client.post("/v1/chat/completions",
+                                         json=body_for(i, stream=True),
+                                         headers=headers)
+                if r.status != 200:
+                    out["error"] = f"http_{r.status}"
+                    return out
+                raw = b""
+                async for chunk in r.content.iter_any():
+                    raw += chunk
+                    if b'"content"' in raw and not out.get("started"):
+                        out["started"] = True
+                        counter[0] += 1
+                        if counter[0] >= streams:
+                            first_byte_evt.set()
+                if b"event: error" in raw:
+                    out["error"] = "error_frame"
+                    return out
+                assert_sse_protocol(raw, "openai")
+                text = stream_text(raw)
+                out["ok"] = True
+                out["identical"] = text == baselines[i]
+                if not out["identical"]:
+                    out["error"] = "diverged"
+                return out
+            except Exception as e:
+                out["error"] = f"{type(e).__name__}"
+                return out
+
+        engines = [{"proc": proc_a, "ep": ep_a, "alive": True},
+                   {"proc": proc_b, "ep": ep_b, "alive": True}]
+
+        async def drill(name: str, victim_sig) -> dict:
+            # Reset the TPS EMAs so every live engine scores "unmeasured"
+            # and the round-robin tie-break spreads the drill's streams
+            # EVENLY — otherwise TPS scoring can concentrate every stream
+            # on one endpoint and killing the other proves nothing.
+            for e in engines:
+                gw.state.load_manager.clear_tps_for_endpoint(e["ep"].id)
+            evt = asyncio.Event()
+            counter = [0]
+            tasks = [asyncio.create_task(one_stream(i, evt, counter))
+                     for i in range(streams)]
+            await asyncio.wait_for(evt.wait(), timeout=60)
+            victim = next(e for e in engines if e["alive"])
+            victim["proc"].send_signal(victim_sig)
+            victim["alive"] = False
+            outs = await asyncio.gather(*tasks)
+            victim["proc"].wait(timeout=30)
+            ok = sum(1 for o in outs if o["ok"])
+            identical = sum(1 for o in outs if o["identical"])
+            return {
+                "streams": streams,
+                "victim": victim["ep"].name,
+                "client_success": ok,
+                "token_identical": identical,
+                "success_rate": round(ok / streams, 4),
+                "errors": [o["error"] for o in outs if o["error"]],
+            }
+
+        summary0 = gw.state.metrics.summary()
+
+        if "kill" in drills:
+            # SIGKILL the busiest engine while every stream is
+            # mid-generation: cut streams must resume on the survivor
+            # token-identically
+            result["drills"]["sigkill"] = await drill("sigkill",
+                                                      signal.SIGKILL)
+            # the victim is gone: take it out of the registry the way the
+            # health checker eventually would, so the next drill is clean
+            for e in engines:
+                if not e["alive"]:
+                    gw.state.registry.update_status(e["ep"].id,
+                                                    EndpointStatus.OFFLINE)
+
+        if "drain" in drills:
+            # spawn a fresh peer so the drained engine has a resume target
+            port_c, proc_c = spawn({"LLMLB_DRAIN_GRACE_S": "0.8"})
+            await _wait_engine_up(gw.state.http, port_c)
+            ep_c = gw.register_mock(f"http://127.0.0.1:{port_c}",
+                                    ["debug-tiny"],
+                                    endpoint_type=EndpointType.TPU,
+                                    name="eng-c")
+            engines.append({"proc": proc_c, "ep": ep_c, "alive": True})
+            # warm the fresh engine (compiles) so the drill's streams are
+            # placeable on it the moment the drain cuts them loose
+            r = await gw.client.post(
+                "/v1/chat/completions",
+                json=body_for(0, stream=False,
+                              content="warmup prompt", max_tokens=8),
+                headers=headers,
+            )
+            await r.read()
+            # SIGTERM the busiest engine (grace 0.8s): in-flight streams
+            # finish inside the grace or are parked + cut for gateway-side
+            # resume — zero client-visible errors either way
+            result["drills"]["sigterm_drain"] = await drill(
+                "sigterm_drain", signal.SIGTERM
+            )
+
+        summary1 = gw.state.metrics.summary()
+        resumes1 = dict(summary1.get("stream_resumes") or {})
+        resumes0 = dict(summary0.get("stream_resumes") or {})
+        result["stream_resumes"] = {
+            k: resumes1.get(k, 0) - resumes0.get(k, 0)
+            for k in set(resumes1) | set(resumes0)
+        }
+        result["stream_resumed_tokens"] = (
+            summary1.get("stream_resumed_tokens_total", 0)
+            - summary0.get("stream_resumed_tokens_total", 0)
+        )
+        result["stream_interruptions"] = (
+            summary1.get("stream_interruptions_total", 0)
+            - summary0.get("stream_interruptions_total", 0)
+        )
+
+        bars = []
+        for name, d in result["drills"].items():
+            bars.append(d["success_rate"] >= 0.99)
+            bars.append(d["token_identical"] == d["client_success"])
+        if "sigterm_drain" in result["drills"]:
+            bars.append(not result["drills"]["sigterm_drain"]["errors"])
+        # the drill is vacuous unless at least one stream actually resumed
+        bars.append(result["stream_resumes"].get("success", 0) >= 1)
+        result["value"] = min(
+            d["success_rate"] for d in result["drills"].values()
+        )
+        result["passed"] = all(bars)
+        result["seconds"] = round(time.monotonic() - t_start, 1)
+        return result
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        await gw.close()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seconds", type=float, default=10.0)
@@ -2198,6 +2515,11 @@ def main() -> None:
                         help="request count for --workload shared-prefix / "
                              "mixed-length / structured / spec-decode / "
                              "quantized")
+    parser.add_argument("--engine-kill", action="store_true",
+                        help="--workload chaos variant: spawn REAL engine "
+                             "processes and SIGKILL one mid-stream (resume "
+                             "drill) then SIGTERM-drain another "
+                             "(docs/resilience.md durable streams)")
     parser.add_argument("--workers", type=int, default=None,
                         help="gateway worker processes: the top of the "
                              "scaling curve for --workload throughput "
@@ -2257,6 +2579,14 @@ def main() -> None:
                   file=sys.stderr)
         result = asyncio.run(run_quantized_bench(max(args.requests, 40)))
     elif args.workload == "chaos":
+        if args.engine_kill:
+            result = asyncio.run(run_chaos_engine_kill(
+                streams=max(8, min(16, args.requests // 2))
+            ))
+            print(json.dumps(result))
+            if not result["passed"]:
+                sys.exit(1)
+            return
         if args.workers and args.workers > 1:
             result = asyncio.run(run_chaos_multiworker(
                 args.seconds, min(args.concurrency, 16), args.workers

@@ -8,7 +8,11 @@ from llmlb_tpu.gateway.api_anthropic import (
     anthropic_request_to_openai,
     openai_response_to_anthropic,
 )
-from tests.support import GatewayHarness, MockOpenAIEndpoint
+from tests.support import (
+    GatewayHarness,
+    MockOpenAIEndpoint,
+    assert_sse_protocol,
+)
 
 
 def test_request_conversion_messages_and_system():
@@ -191,6 +195,7 @@ def test_messages_endpoint_non_stream_and_stream():
             }, headers=headers)
             assert r.status == 200
             raw = (await r.read()).decode()
+            assert_sse_protocol(raw.encode(), "anthropic")
             event_names = [l.split(": ", 1)[1] for l in raw.splitlines()
                            if l.startswith("event: ")]
             assert event_names[0] == "message_start"

@@ -1,0 +1,37 @@
+"""Tier-1 chaos drill: REAL engine processes, real SIGKILL/SIGTERM.
+
+Drives scripts/bench_gateway.run_chaos_engine_kill in-process: the real
+gateway in front of two spawned engine-server processes (CPU backend,
+seed-0 weights), 8 streams mid-generation, then (a) SIGKILL the busiest
+engine — every cut stream must resume token-identically on the survivor —
+and (b) SIGTERM + drain another — zero client-visible errors. The
+mock-level unit tests live in test_stream_resume.py; this is the
+end-to-end proof against real process death.
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "scripts"))
+
+
+def test_chaos_engine_kill_and_drain():
+    import bench_gateway
+
+    result = asyncio.run(bench_gateway.run_chaos_engine_kill(streams=8))
+    assert result["passed"], result
+
+    kill = result["drills"]["sigkill"]
+    assert kill["success_rate"] >= 0.99, result
+    assert kill["token_identical"] == kill["client_success"], result
+
+    drain = result["drills"]["sigterm_drain"]
+    assert drain["success_rate"] >= 0.99, result
+    assert drain["errors"] == [], result  # zero client-visible errors
+    assert drain["token_identical"] == drain["client_success"], result
+
+    # non-vacuous: streams were actually cut and actually resumed
+    assert result["stream_interruptions"] >= 1, result
+    assert result["stream_resumes"].get("success", 0) >= 1, result
+    assert result["stream_resumed_tokens"] >= 0

@@ -7,7 +7,11 @@ real app + in-memory DB + mock upstream endpoints.
 import asyncio
 import json
 
-from tests.support import MockOpenAIEndpoint, GatewayHarness
+from tests.support import (
+    GatewayHarness,
+    MockOpenAIEndpoint,
+    assert_sse_protocol,
+)
 
 
 def test_auth_contract():
@@ -120,6 +124,7 @@ def test_chat_completion_proxy_stream_passthrough_and_tps():
             assert r.status == 200
             raw = (await r.read()).decode()
             assert "tok0" in raw and raw.strip().endswith("data: [DONE]")
+            assert_sse_protocol(raw.encode(), "openai")
             # stream_options.include_usage was injected toward upstream
             assert mock.requests_seen[-1]["stream_options"]["include_usage"]
 

@@ -1,0 +1,150 @@
+"""Drain-aware gateway routing (docs/deployment.md rolling-restart runbook):
+a draining engine advertises itself on /api/health, the pull checker flips
+it out of selection within one probe interval, and a model whose endpoints
+are ALL draining queues and 503s (with Retry-After derived from the drain
+grace) — it never 404s and nothing strands. Tier-1, no real engines.
+"""
+
+import asyncio
+
+from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.health import EndpointHealthChecker
+from llmlb_tpu.gateway.types import EndpointStatus, EndpointType
+from tests.support import GatewayHarness, MockResumableEndpoint
+
+CHAT = "/v1/chat/completions"
+
+
+def _chat_body(stream=False):
+    body = {"model": "m",
+            "messages": [{"role": "user", "content": "ping"}]}
+    if stream:
+        body["stream"] = True
+    return body
+
+
+def _checker(gw) -> EndpointHealthChecker:
+    return EndpointHealthChecker(
+        gw.state.registry, gw.state.load_manager, gw.state.db,
+        gw.state.http, events=gw.state.events,
+        interval_s=3600.0, timeout_s=2.0,
+    )
+
+
+def test_probe_flips_draining_endpoint_out_of_selection():
+    """One probe cycle is enough: traffic stops landing on the draining
+    engine, resumes when a later probe sees it healthy again."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a = await MockResumableEndpoint(model="m").start()
+            b = await MockResumableEndpoint(model="m").start()
+            ep_a = gw.register_mock(a.url, ["m"],
+                                    endpoint_type=EndpointType.TPU,
+                                    name="eng-a")
+            gw.register_mock(b.url, ["m"], endpoint_type=EndpointType.TPU,
+                             name="eng-b")
+            checker = _checker(gw)
+            headers = await gw.inference_headers()
+
+            a.draining = True
+            a.drain_remaining_s = 25.0
+            await checker.check_all()
+            # still ONLINE (its models must not 404) but ejected from
+            # selection
+            ep = gw.state.registry.get(ep_a.id)
+            assert ep.status == EndpointStatus.ONLINE
+            assert ep.accelerator.draining is True
+
+            a_before = len(a.requests_seen)
+            for _ in range(6):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200, await r.text()
+                await r.read()
+            assert len(a.requests_seen) == a_before  # zero new traffic
+            assert len(b.requests_seen) >= 6
+
+            # drain over (engine restarted): next probe restores selection
+            a.draining = False
+            a.drain_remaining_s = 0.0
+            await checker.check_all()
+            assert gw.state.registry.get(ep_a.id).accelerator.draining is False
+            for _ in range(4):
+                r = await gw.client.post(CHAT, json=_chat_body(),
+                                         headers=headers)
+                assert r.status == 200
+                await r.read()
+            assert len(a.requests_seen) > a_before  # traffic returned
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_all_endpoints_draining_queue_then_503_never_404():
+    """Every endpoint for the model draining = a capacity condition: the
+    request queues, then 503s with Retry-After derived from the soonest
+    drain completion. It must never 404 — the model is still registered."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = None
+        try:
+            a = await MockResumableEndpoint(model="m").start()
+            gw.register_mock(a.url, ["m"], endpoint_type=EndpointType.TPU,
+                             name="only")
+            gw.state.load_manager.queue_config = QueueConfig(
+                queue_timeout_s=0.2)
+            checker = _checker(gw)
+            a.draining = True
+            a.drain_remaining_s = 12.0
+            await checker.check_all()
+
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 503, await r.text()
+            retry_after = int(r.headers["Retry-After"])
+            # derived from the advertised drain remaining (ceil(12) = 12)
+            assert retry_after == 12
+            body = await r.json()
+            assert body["error"]["type"] == "server_error"
+        finally:
+            if a is not None:
+                await a.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_anthropic_dialect_sees_drain_503_with_retry_after():
+    async def run():
+        gw = await GatewayHarness.create()
+        a = None
+        try:
+            a = await MockResumableEndpoint(model="m").start()
+            gw.register_mock(a.url, ["m"], endpoint_type=EndpointType.TPU,
+                             name="only")
+            gw.state.load_manager.queue_config = QueueConfig(
+                queue_timeout_s=0.2)
+            a.draining = True
+            a.drain_remaining_s = 7.0
+            await _checker(gw).check_all()
+            headers = await gw.inference_headers()
+            r = await gw.client.post(
+                "/v1/messages",
+                json={"model": "m", "max_tokens": 8,
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers=headers,
+            )
+            assert r.status == 503
+            assert int(r.headers["Retry-After"]) == 7
+            body = await r.json()
+            assert body["error"]["type"] == "overloaded_error"
+        finally:
+            if a is not None:
+                await a.stop()
+            await gw.close()
+    asyncio.run(run())

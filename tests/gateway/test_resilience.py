@@ -20,7 +20,11 @@ from llmlb_tpu.gateway.resilience import (
     backoff_delay,
 )
 from llmlb_tpu.gateway.types import EndpointStatus
-from tests.support import GatewayHarness, MockOpenAIEndpoint
+from tests.support import (
+    GatewayHarness,
+    MockOpenAIEndpoint,
+    assert_sse_protocol,
+)
 
 CHAT = "/v1/chat/completions"
 
@@ -234,6 +238,7 @@ def test_failover_stream_pre_first_byte():
                 text = (await r.read()).decode()
                 assert "data: [DONE]" in text
                 assert "event: error" not in text
+                assert_sse_protocol(text.encode(), "openai")
         finally:
             await alive.stop()
             await dead.stop()
@@ -262,6 +267,7 @@ def test_midstream_cut_emits_error_frame_and_counts_outcome():
             assert r.status == 200  # stream had already committed
             text = (await r.read()).decode()
             assert "event: error" in text
+            assert_sse_protocol(text.encode(), "openai", allow_error=True)
             frame = text.split("event: error\ndata: ")[1].split("\n")[0]
             err = json.loads(frame)["error"]
             assert err["code"] == "stream_interrupted"
@@ -300,6 +306,7 @@ def test_anthropic_midstream_cut_emits_native_error_event():
             assert "event: error" in text
             assert '"type":"error"' in text
             assert "message_stop" not in text.split("event: error")[1]
+            assert_sse_protocol(text.encode(), "anthropic", allow_error=True)
         finally:
             await mock.stop()
             await gw.close()

@@ -17,7 +17,11 @@ from llmlb_tpu.engine.server import create_engine_app
 from llmlb_tpu.engine.service import Engine
 from llmlb_tpu.gateway.health import EndpointHealthChecker
 from llmlb_tpu.gateway.types import Capability
-from tests.support import GatewayHarness, MockOpenAIEndpoint
+from tests.support import (
+    GatewayHarness,
+    MockOpenAIEndpoint,
+    assert_sse_protocol,
+)
 
 SCHEMA = {
     "type": "object",
@@ -76,6 +80,7 @@ def test_structured_outputs_through_gateway(engine):
             }, headers=iheaders)
             assert r.status == 200, await r.text()
             raw = (await r.read()).decode()
+            assert_sse_protocol(raw.encode(), "openai")
             text, finish = "", None
             for line in raw.splitlines():
                 if not line.startswith("data: ") or line == "data: [DONE]":

@@ -31,6 +31,171 @@ TEST_JWT_SECRET = "test-jwt-secret"
 ADMIN_PASSWORD = "adminpass1"
 
 
+# --------------------------------------------------- SSE protocol invariants
+
+
+def parse_sse_frames(body: bytes) -> list[dict]:
+    """Split a raw SSE body into frames: [{"event": str|None, "data": [raw
+    data strings]}]. Frames are terminated by a blank line; a trailing
+    partial frame (no terminator — a cut stream) is included as-is."""
+    frames: list[dict] = []
+    for block in body.split(b"\n\n"):
+        if not block.strip():
+            continue
+        frame = {"event": None, "data": []}
+        for line in block.split(b"\n"):
+            line = line.strip()
+            if line.startswith(b"event:"):
+                frame["event"] = line[len(b"event:"):].strip().decode()
+            elif line.startswith(b"data:"):
+                frame["data"].append(line[len(b"data:"):].strip().decode())
+        if frame["event"] is not None or frame["data"]:
+            frames.append(frame)
+    return frames
+
+
+def assert_sse_protocol(body: bytes, dialect: str = "openai",
+                        allow_error: bool = False) -> None:
+    """Protocol-invariant checker for gateway SSE streams (applied to every
+    gateway stream test, not just the resume tests):
+
+    - exactly one role delta (OpenAI) / exactly one message_start
+      (Anthropic) — a spliced resume must never re-open the message;
+    - monotone indices (OpenAI choice index non-decreasing; Anthropic
+      content_block indices strictly increasing, deltas only to the open
+      block);
+    - exactly one terminal frame (``[DONE]`` / ``message_stop``) and no
+      frames after it; with ``allow_error`` an ``event: error`` frame may
+      terminate instead (optionally followed by one ``[DONE]``);
+    - no gateway-internal ``llmlb.replay`` frames leak to the client.
+    """
+    frames = parse_sse_frames(body)
+    assert frames, "stream produced no SSE frames"
+    if dialect == "openai":
+        _assert_openai_stream(frames, allow_error)
+    elif dialect == "anthropic":
+        _assert_anthropic_stream(frames, allow_error)
+    else:  # pragma: no cover - test-author error
+        raise ValueError(f"unknown dialect {dialect!r}")
+
+
+def _assert_openai_stream(frames: list[dict], allow_error: bool) -> None:
+    done_seen = 0
+    error_seen = 0
+    role_deltas = 0
+    last_choice_index = -1
+    terminal_at: int | None = None
+    for i, frame in enumerate(frames):
+        if terminal_at is not None and frame["data"] != []:
+            raise AssertionError(
+                f"frame after terminal [DONE]: {frame!r}"
+            )
+        if frame["event"] == "error":
+            error_seen += 1
+            assert allow_error, f"unexpected error frame: {frame!r}"
+            continue
+        for raw in frame["data"]:
+            if raw == "[DONE]":
+                done_seen += 1
+                terminal_at = i
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                if allow_error:
+                    # an interrupted byte-passthrough stream may end with a
+                    # truncated partial frame before the error frame — the
+                    # one shape a cut legitimately produces
+                    continue
+                raise AssertionError(f"unparseable data frame: {raw!r}")
+            if not isinstance(obj, dict):
+                continue
+            assert obj.get("object") != "llmlb.replay", (
+                "gateway-internal llmlb.replay frame leaked to the client"
+            )
+            if "error" in obj and "choices" not in obj:
+                error_seen += 1
+                assert allow_error, f"unexpected error payload: {raw!r}"
+                continue
+            for choice in obj.get("choices") or []:
+                idx = choice.get("index", 0)
+                assert idx >= last_choice_index, (
+                    f"choice index went backwards: {idx} after "
+                    f"{last_choice_index}"
+                )
+                last_choice_index = max(last_choice_index, idx)
+                delta = choice.get("delta") or {}
+                if delta.get("role"):
+                    role_deltas += 1
+    assert done_seen <= 1, f"{done_seen} [DONE] frames (expected exactly 1)"
+    if error_seen == 0:
+        assert done_seen == 1, "completed stream must end with one [DONE]"
+    assert role_deltas <= 1, (
+        f"{role_deltas} role deltas (a resumed stream must not re-open "
+        "the message)"
+    )
+
+
+def _assert_anthropic_stream(frames: list[dict], allow_error: bool) -> None:
+    starts = 0
+    stops = 0
+    open_block: int | None = None
+    last_block_index = -1
+    terminal = False
+    for frame in frames:
+        assert not terminal, f"frame after message_stop: {frame!r}"
+        if frame["event"] == "error":
+            assert allow_error, f"unexpected error event: {frame!r}"
+            terminal = True
+            continue
+        for raw in frame["data"]:
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                if allow_error:
+                    continue  # truncated partial frame on a cut stream
+                raise AssertionError(f"unparseable data frame: {raw!r}")
+            etype = obj.get("type")
+            if etype == "message_start":
+                starts += 1
+                assert starts == 1, "second message_start on one stream"
+            elif etype == "content_block_start":
+                idx = obj.get("index")
+                assert open_block is None, (
+                    f"content_block_start for {idx} while block "
+                    f"{open_block} is open"
+                )
+                assert idx > last_block_index, (
+                    f"content_block index not increasing: {idx} after "
+                    f"{last_block_index}"
+                )
+                open_block = idx
+                last_block_index = idx
+            elif etype == "content_block_delta":
+                assert obj.get("index") == open_block, (
+                    f"delta for block {obj.get('index')} but open block "
+                    f"is {open_block}"
+                )
+            elif etype == "content_block_stop":
+                assert obj.get("index") == open_block, (
+                    f"stop for block {obj.get('index')} but open block "
+                    f"is {open_block}"
+                )
+                open_block = None
+            elif etype == "message_stop":
+                stops += 1
+                terminal = True
+            elif etype == "error":
+                assert allow_error, f"unexpected error payload: {raw!r}"
+                terminal = True
+    assert starts == 1 or (allow_error and starts == 0), (
+        "stream must carry exactly one message_start"
+    )
+    if not allow_error:
+        assert stops == 1, "stream must end with exactly one message_stop"
+    assert stops <= 1, f"{stops} message_stop events"
+
+
 class MockOpenAIEndpoint:
     """A fake OpenAI-compatible runtime with configurable behavior."""
 
@@ -146,6 +311,126 @@ class MockOpenAIEndpoint:
             "model": body.get("model"),
             "usage": {"prompt_tokens": 4, "total_tokens": 4},
         })
+
+
+class MockResumableEndpoint(MockOpenAIEndpoint):
+    """A mock tpu:// engine for durable-stream tests: streams a scripted
+    token sequence with gateway-internal ``llmlb.replay`` frames when the
+    request is armed (``llmlb_replay: true``), and adopts cut streams on
+    ``/v1/resume`` — replaying the committed ids and emitting the FULL text
+    exactly as a real engine's adopt path does (token i renders as
+    ``t<i> ``, deterministic across instances, so splice identity is
+    checkable byte for byte)."""
+
+    def __init__(self, *, model="mock-model", script=None,
+                 tokens_per_chunk=1, inter_chunk_delay_s=0.002,
+                 resume_fail_with: int | None = None):
+        super().__init__(model=model,
+                         inter_chunk_delay_s=inter_chunk_delay_s)
+        # the full token sequence every instance of this "model" generates
+        self.script = list(script if script is not None else range(100, 112))
+        self.tokens_per_chunk = max(1, tokens_per_chunk)
+        self.resume_fail_with = resume_fail_with
+        self.resume_calls: list[dict] = []
+        # graceful-drain advertisement (flip from tests; the gateway's
+        # health probe re-parses it every cycle)
+        self.draining = False
+        self.drain_remaining_s = 0.0
+
+    @staticmethod
+    def text_of(token_id: int) -> str:
+        return f"t{token_id} "
+
+    async def start(self) -> "MockResumableEndpoint":
+        app = web.Application()
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_get("/api/health", self._health)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/resume", self._resume)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def _health(self, request):
+        return web.json_response({
+            "status": "draining" if self.draining else "ok",
+            "tpu": {"accelerator": "tpu", "chip_count": 1},
+            "engine": {"num_slots": 4, "active_slots": 0, "queued": 0},
+            "draining": {"draining": self.draining, "grace_s": 30.0,
+                         "remaining_s": self.drain_remaining_s},
+        })
+
+    async def _stream_script(self, request, body, start_token: int):
+        """Stream self.script[start:] as chat chunks; with llmlb_replay,
+        each chunk's ids ship first as an llmlb.replay frame (the engine
+        contract: tokens always cover every character already sent)."""
+        armed = bool(body.get("llmlb_replay"))
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+
+        async def send(obj) -> None:
+            await resp.write(
+                b"data: " + json.dumps(obj).encode() + b"\n\n"
+            )
+
+        def chunk(delta, finish=None):
+            return {
+                "id": "chatcmpl-mockresume", "object": "chat.completion.chunk",
+                "created": 1700000000, "model": body.get("model"),
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}],
+            }
+
+        await send(chunk({"role": "assistant", "content": ""}))
+        toks = self.script[start_token:]
+        for i in range(0, len(toks), self.tokens_per_chunk):
+            group = toks[i:i + self.tokens_per_chunk]
+            if armed:
+                await send({"object": "llmlb.replay", "tokens": group})
+            await send(chunk(
+                {"content": "".join(self.text_of(t) for t in group)}
+            ))
+            if self.inter_chunk_delay_s:
+                await asyncio.sleep(self.inter_chunk_delay_s)
+        await send(chunk({}, "stop"))
+        await send({
+            "id": "chatcmpl-mockresume", "object": "chat.completion.chunk",
+            "choices": [],
+            "usage": {"prompt_tokens": 7,
+                      "completion_tokens": len(self.script),
+                      "total_tokens": 7 + len(self.script)},
+        })
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+    async def _chat(self, request):
+        body = await request.json()
+        self.requests_seen.append(body)
+        self.headers_seen.append(dict(request.headers))
+        if self.fail_with:
+            return web.json_response({"error": "induced"},
+                                     status=self.fail_with)
+        if not body.get("stream"):
+            return await super()._chat(request)
+        return await self._stream_script(request, body, 0)
+
+    async def _resume(self, request):
+        body = await request.json()
+        self.resume_calls.append(body)
+        if self.resume_fail_with:
+            return web.json_response({"error": "induced"},
+                                     status=self.resume_fail_with)
+        committed = body.get("committed_ids") or []
+        # a real engine replays prompt+committed then CONTINUES — committed
+        # ids must be a prefix of what this model deterministically generates
+        assert committed == self.script[:len(committed)], (
+            f"committed ids {committed} are not a prefix of {self.script}"
+        )
+        # full text from token 0: the adopt path re-emits committed text and
+        # the gateway splices off what its client already holds
+        return await self._stream_script(request, body, 0)
 
 
 class MockDisaggEndpoint(MockOpenAIEndpoint):

@@ -1,0 +1,61 @@
+"""Tier-1 guard: no silent exception swallows in llmlb_tpu/.
+
+Runs scripts/check_silent_except.py in-process: bare ``except:`` and
+``except Exception: pass`` handlers without an explicit
+``# allow-silent: <reason>`` annotation fail the build — crash-recovery
+code (durable streams, drain, failover) must not hide the errors it
+exists to surface.
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import check_silent_except  # noqa: E402
+
+
+def test_no_silent_swallows_in_tree():
+    findings = []
+    for path in sorted(check_silent_except.SRC.rglob("*.py")):
+        for lineno, what in check_silent_except.check_file(path):
+            findings.append(f"{path.relative_to(check_silent_except.REPO)}:"
+                            f"{lineno}: {what}")
+    assert not findings, "\n".join(findings)
+
+
+def test_checker_flags_the_patterns(tmp_path):
+    """The checker must catch both flagged shapes and honor the marker."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        try:
+            x = 1
+        except:
+            x = 2
+        try:
+            y = 1
+        except Exception:
+            pass
+    """))
+    findings = check_silent_except.check_file(bad)
+    assert len(findings) == 2
+    assert findings[0][1] == "bare `except:`"
+
+    ok = tmp_path / "ok.py"
+    ok.write_text(textwrap.dedent("""
+        import logging
+        try:
+            x = 1
+        except Exception:
+            logging.exception("boom")  # surfaced: not a swallow
+        try:
+            y = 1
+        except Exception:  # allow-silent: unit-test fixture teardown
+            pass
+        try:
+            z = 1
+        except ValueError:
+            pass  # narrow excepts may pass silently — they chose a type
+    """))
+    assert check_silent_except.check_file(ok) == []
