@@ -75,6 +75,24 @@ class Histogram:
         return max(self.edges[-1], self.max)
 
 
+
+def _render_histogram(lines: list, name: str, hist: "Histogram",
+                      label: str = "") -> None:
+    """Append one histogram family in Prometheus exposition form (shared by
+    every histogram block in render() — cumulative buckets, +Inf, sum,
+    count). `label` is a pre-rendered `k="v"` pair for labeled families."""
+    brace = f"{{{label},le=" if label else "{le="
+    cumulative = 0
+    for i, edge in enumerate(hist.edges):
+        cumulative += hist.counts[i]
+        lines.append(f'{name}_bucket{brace}"{edge}"}} {cumulative}')
+    cumulative += hist.counts[-1]
+    lines.append(f'{name}_bucket{brace}"+Inf"}} {cumulative}')
+    suffix = f"{{{label}}}" if label else ""
+    lines.append(f"{name}_sum{suffix} {hist.total}")
+    lines.append(f"{name}_count{suffix} {hist.n}")
+
+
 class EngineMetrics:
     def __init__(self):
         self._lock = threading.Lock()
@@ -145,6 +163,17 @@ class EngineMetrics:
         # via the gateway's replay path).
         self.drain_state = 0
         self.drain_parked_total = 0
+        # Multi-LoRA serving (llmlb_tpu/lora, docs/lora.md): adapter
+        # hot-loads/evictions (their RATE is the thrash signal the
+        # EngineLoraThrash alert pages on), disk→device load latency, and a
+        # cardinality-capped per-adapter request counter. The residency
+        # gauge (llmlb_engine_lora_loaded) scrapes live from the manager at
+        # render time — state, not an event.
+        self.lora_loads_total = 0
+        self.lora_evictions_total = 0
+        self.lora_load = Histogram(COMPILE_BUCKETS)
+        self.lora_requests_total: dict[str, int] = {}
+        self._LORA_LABEL_CAP = 64
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -290,6 +319,27 @@ class EngineMetrics:
         with self._lock:
             self.handoff_backlog = n
 
+    def record_lora_load(self, seconds: float) -> None:
+        with self._lock:
+            self.lora_loads_total += 1
+            self.lora_load.observe(seconds)
+
+    def record_lora_eviction(self) -> None:
+        with self._lock:
+            self.lora_evictions_total += 1
+
+    def record_lora_request(self, adapter: str) -> None:
+        """Per-adapter request counter (docs/lora.md). Label cardinality is
+        bounded: past _LORA_LABEL_CAP distinct adapters, further names fold
+        into the "_other" label instead of growing /metrics without bound."""
+        with self._lock:
+            if (adapter not in self.lora_requests_total
+                    and len(self.lora_requests_total) >= self._LORA_LABEL_CAP):
+                adapter = "_other"
+            self.lora_requests_total[adapter] = (
+                self.lora_requests_total.get(adapter, 0) + 1
+            )
+
     def set_drain_state(self, state: int) -> None:
         with self._lock:
             self.drain_state = int(state)
@@ -345,6 +395,8 @@ class EngineMetrics:
                 "handoff_latency_p50_s": self.handoff_latency.percentile(50),
                 "drain_state": self.drain_state,
                 "drain_parked_total": self.drain_parked_total,
+                "lora_loads_total": self.lora_loads_total,
+                "lora_evictions_total": self.lora_evictions_total,
             }
 
     def render(self, *, queue_depth: int, active_slots: int,
@@ -353,7 +405,8 @@ class EngineMetrics:
                structured: dict | None = None,
                perf: dict | None = None,
                quant: dict | None = None,
-               sched: dict | None = None) -> str:
+               sched: dict | None = None,
+               lora: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
@@ -475,6 +528,40 @@ class EngineMetrics:
                             f'llmlb_engine_queue_depth_role'
                             f'{{role="{name}"}} {depth}'
                         )
+            if lora is not None and lora.get("enabled"):
+                # Multi-LoRA serving (docs/lora.md): residency gauges scrape
+                # the manager's live state; load/evict counters and the
+                # per-adapter request counter are event-sourced above.
+                lines += [
+                    "# TYPE llmlb_engine_lora_loaded gauge",
+                    "llmlb_engine_lora_loaded "
+                    f"{len(lora.get('resident') or ())}",
+                    "# TYPE llmlb_engine_lora_available gauge",
+                    "llmlb_engine_lora_available "
+                    f"{len(lora.get('available') or ())}",
+                    "# TYPE llmlb_engine_lora_max_adapters gauge",
+                    "llmlb_engine_lora_max_adapters "
+                    f"{lora.get('max_adapters', 0)}",
+                    "# TYPE llmlb_engine_lora_loads_total counter",
+                    f"llmlb_engine_lora_loads_total {self.lora_loads_total}",
+                    "# TYPE llmlb_engine_lora_evictions_total counter",
+                    "llmlb_engine_lora_evictions_total "
+                    f"{self.lora_evictions_total}",
+                ]
+                if self.lora_requests_total:
+                    lines.append(
+                        "# TYPE llmlb_engine_lora_requests_total counter"
+                    )
+                    for name_, count in sorted(
+                        self.lora_requests_total.items()
+                    ):
+                        lines.append(
+                            'llmlb_engine_lora_requests_total'
+                            f'{{adapter="{name_}"}} {count}'
+                        )
+                hname = "llmlb_engine_lora_load_seconds"
+                lines.append(f"# TYPE {hname} histogram")
+                _render_histogram(lines, hname, self.lora_load)
             if perf is not None and perf.get("available"):
                 lines += [
                     "# TYPE llmlb_engine_mfu_ratio gauge",
@@ -571,31 +658,12 @@ class EngineMetrics:
                  self.handoff_latency),
             ):
                 lines.append(f"# TYPE {name} histogram")
-                cumulative = 0
-                for i, edge in enumerate(hist.edges):
-                    cumulative += hist.counts[i]
-                    lines.append(
-                        f'{name}_bucket{{le="{edge}"}} {cumulative}'
-                    )
-                cumulative += hist.counts[-1]
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cumulative}')
-                lines.append(f"{name}_sum {hist.total}")
-                lines.append(f"{name}_count {hist.n}")
+                _render_histogram(lines, name, hist)
             # per-phase step breakdown: one histogram family labeled by
             # phase (engine/stepstats.py taxonomy); empty phases still
             # render so dashboards see a complete label set
             name = "llmlb_engine_step_phase_seconds"
             lines.append(f"# TYPE {name} histogram")
             for phase, hist in self.step_phase.items():
-                label = f'phase="{phase}"'
-                cumulative = 0
-                for i, edge in enumerate(hist.edges):
-                    cumulative += hist.counts[i]
-                    lines.append(
-                        f'{name}_bucket{{{label},le="{edge}"}} {cumulative}'
-                    )
-                cumulative += hist.counts[-1]
-                lines.append(f'{name}_bucket{{{label},le="+Inf"}} {cumulative}')
-                lines.append(f"{name}_sum{{{label}}} {hist.total}")
-                lines.append(f"{name}_count{{{label}}} {hist.n}")
+                _render_histogram(lines, name, hist, label=f'phase="{phase}"')
             return "\n".join(lines) + "\n"

@@ -91,13 +91,27 @@ class PrefixCache:
         self.max_entries = max_entries
         self.min_len = min_len
         self.align = align
-        self._root = _Node(())
+        # One radix tree per namespace (`ns`): under multi-LoRA serving the
+        # prompt KV depends on the adapter's wq/wk/wv deltas, so two
+        # adapters sharing a prompt must NEVER share cached KV — an
+        # adapter-blind hit would be silent corruption (docs/lora.md). The
+        # default ns=None tree is the historical adapter-free cache, bit
+        # for bit; budget and LRU stay GLOBAL across namespaces (one donor
+        # pool, shared fairly by eviction pressure, like the PR 5 mask
+        # cache's single LRU over many schemas).
+        self._roots: dict[object, _Node] = {None: _Node(())}
         # keyed by entry.key: the donor slot id for dense entries, a unique
         # negative id for paged (page-backed) entries
         self._by_slot: dict[int, PrefixEntry] = {}
         self._cached_tokens = 0
         self._clock = 0
         self._next_paged_key = -2  # -1 is the scheduler's "no slot" marker
+
+    def _root_for(self, ns) -> "_Node":
+        root = self._roots.get(ns)
+        if root is None:
+            root = self._roots[ns] = _Node(())
+        return root
 
     # ------------------------------------------------------------- inspection
     #
@@ -130,12 +144,12 @@ class PrefixCache:
 
     # ------------------------------------------------------------------ match
 
-    def _walk(self, tokens) -> tuple[int, _Node]:
-        """Follow `tokens` as far as they match. Returns (matched_len,
-        last_node_entered). The last node may be only partially matched
-        (mismatch mid-edge); every entry in its subtree still shares the
-        first `matched_len` tokens with the query."""
-        node = self._root
+    def _walk(self, tokens, ns=None) -> tuple[int, _Node]:
+        """Follow `tokens` as far as they match within namespace `ns`.
+        Returns (matched_len, last_node_entered). The last node may be only
+        partially matched (mismatch mid-edge); every entry in its subtree
+        still shares the first `matched_len` tokens with the query."""
+        node = self._root_for(ns)
         matched = 0
         while matched < len(tokens):
             child = node.children.get(tokens[matched])
@@ -160,7 +174,8 @@ class PrefixCache:
             stack.extend(n.children.values())
         return None
 
-    def match(self, tokens, *, max_len: int) -> tuple[PrefixEntry, int] | None:
+    def match(self, tokens, *, max_len: int,
+              ns=None) -> tuple[PrefixEntry, int] | None:
         """Longest reusable cached prefix of `tokens`: returns (entry,
         use_len) where entry's slot holds valid KV for rows [0, use_len) and
         use_len is capped at `max_len` (the caller must leave at least one
@@ -169,7 +184,7 @@ class PrefixCache:
         >= min_len is cached. Bumps the winning entry's LRU clock."""
         if max_len < self.min_len or not self._by_slot:
             return None
-        matched, node = self._walk(tokens)
+        matched, node = self._walk(tokens, ns)
         if not matched:
             return None
         # pruning keeps every non-empty subtree holding >= 1 entry, so a
@@ -184,16 +199,16 @@ class PrefixCache:
         entry.last_used = self._tick()
         return entry, usable
 
-    def covers(self, tokens) -> bool:
+    def covers(self, tokens, ns=None) -> bool:
         """True if some entry already holds ALL of `tokens` as its head —
         inserting them again would pin a second slot for no new coverage."""
-        matched, node = self._walk(tokens)
+        matched, node = self._walk(tokens, ns)
         return matched == len(tokens) and self._any_entry(node) is not None
 
-    def touch(self, tokens) -> None:
+    def touch(self, tokens, ns=None) -> None:
         """Refresh the LRU clock of the entry covering `tokens` (a completed
         request whose prefix was already cached is a use of that entry)."""
-        matched, node = self._walk(tokens)
+        matched, node = self._walk(tokens, ns)
         if matched == len(tokens):
             entry = self._any_entry(node)
             if entry is not None:
@@ -211,19 +226,20 @@ class PrefixCache:
     # ----------------------------------------------------------------- insert
 
     def insert(self, tokens, slot: int,
-               pages: tuple[int, ...] | None = None) -> PrefixEntry | None:
-        """Pin a donor for prefix `tokens`: slot `slot` (dense) or the pool
-        pages `pages` (paged; pass slot=-1). Returns the new entry, or None
-        when rejected (budget full, duplicate coverage, or a slot already
-        pinned). The caller aligns/filters lengths, evicts to make room
-        first, and owns the page refcounts."""
+               pages: tuple[int, ...] | None = None,
+               ns=None) -> PrefixEntry | None:
+        """Pin a donor for prefix `tokens` in namespace `ns`: slot `slot`
+        (dense) or the pool pages `pages` (paged; pass slot=-1). Returns
+        the new entry, or None when rejected (budget full, duplicate
+        coverage, or a slot already pinned). The caller aligns/filters
+        lengths, evicts to make room first, and owns the page refcounts."""
         tokens = tuple(tokens)
         if (not tokens
                 or (pages is None and slot in self._by_slot)
                 or len(self._by_slot) >= self.max_entries
-                or self.covers(tokens)):
+                or self.covers(tokens, ns)):
             return None
-        node = self._root
+        node = self._root_for(ns)
         pos = 0
         while pos < len(tokens):
             child = node.children.get(tokens[pos])
@@ -259,12 +275,12 @@ class PrefixCache:
 
     # ------------------------------------------------------------------ evict
 
-    def evict_subsumed(self, tokens) -> list[int]:
+    def evict_subsumed(self, tokens, ns=None) -> list[int]:
         """Remove entries whose tokens are a STRICT prefix of `tokens`,
         returning their freed slots (see evict_subsumed_entries)."""
-        return [e.slot for e in self.evict_subsumed_entries(tokens)]
+        return [e.slot for e in self.evict_subsumed_entries(tokens, ns)]
 
-    def evict_subsumed_entries(self, tokens) -> list["PrefixEntry"]:
+    def evict_subsumed_entries(self, tokens, ns=None) -> list["PrefixEntry"]:
         """Remove entries whose tokens are a STRICT prefix of `tokens` (and
         have no in-flight readers), returning them so the caller can release
         their donor slots / page references. Called before inserting
@@ -274,7 +290,7 @@ class PrefixCache:
         until the budget was exhausted."""
         tokens = tuple(tokens)
         victims: list[PrefixEntry] = []
-        node = self._root
+        node = self._root_for(ns)
         pos = 0
         while pos < len(tokens):
             child = node.children.get(tokens[pos])
@@ -339,6 +355,6 @@ class PrefixCache:
     def clear(self) -> None:
         """Drop everything — the device KV the entries pointed at is gone
         (engine failure path rebuilds the slot cache)."""
-        self._root = _Node(())
+        self._roots = {None: _Node(())}
         self._by_slot.clear()
         self._cached_tokens = 0

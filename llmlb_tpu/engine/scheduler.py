@@ -223,6 +223,14 @@ class SamplingParams:
     # prefill; the gateway propagates client deadlines via the
     # X-Request-Deadline-Ms header.
     deadline_ms: float | None = None
+    # LoRA adapter name (docs/lora.md): selected by the `lora` field or the
+    # `model:adapter` suffix on both dialects. A plain string so it rides
+    # the multihost plan wire, the /v1/handoff disagg wire, and /v1/resume
+    # replay for free (test_plan_wire/test_handoff_wire auto-probe it).
+    # Resolution to a pool row happens at submit (EngineCore.prepare_lora);
+    # park/resume re-prefills with the same adapter so resumed streams stay
+    # token-identical.
+    lora: str | None = None
 
 
 @dataclasses.dataclass
@@ -362,6 +370,9 @@ class EngineCore:
         prefill_chunk_budget: int | None = None,
         role: str | None = None,
         disagg_prefill_slots: int | None = None,
+        lora_dir: str | None = None,
+        lora_max_adapters: int | None = None,
+        lora_rank_cap: int | None = None,
     ):
         self.cfg = cfg
         # Serving role (docs/disaggregation.md): "both" (default) is the
@@ -503,10 +514,57 @@ class EngineCore:
             # bf16 pytrees quantize here so every construction path serves
             # the same int8 layout.
             params = quantize_params(params)
+
+        # Multi-LoRA serving (llmlb_tpu/lora, docs/lora.md): a device-resident
+        # adapter pool rides the param pytree as `<name>_lora_a/_lora_b`
+        # companions (zeros at boot; hot-loaded rows overwrite in place), and
+        # every dispatch carries per-row adapter indices. OFF by default —
+        # with no pool in the pytree every forward compiles the original
+        # program bit for bit (the quantize-off contract, tier-1 pinned).
+        # Adapter deltas stay bf16 on top of (possibly int8) base weights:
+        # the delta adds to the projection OUTPUT, so the dequant-on-read
+        # path above is untouched.
+        if lora_dir is None:
+            lora_dir = os.environ.get("LLMLB_LORA_DIR") or None
+        self.lora = None
+        if lora_dir:
+            from llmlb_tpu.lora import LoraManager
+
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "--lora-dir is single-host only for now: followers have "
+                    "no deterministic mirror of the leader's adapter pool "
+                    "slot assignment"
+                )
+            if lora_max_adapters is None:
+                lora_max_adapters = int(os.environ.get(
+                    "LLMLB_LORA_MAX_ADAPTERS", "8"))
+            if lora_rank_cap is None:
+                lora_rank_cap = int(os.environ.get(
+                    "LLMLB_LORA_RANK_CAP", "16"))
+            # MoE families serve attention-target adapters only (no pools
+            # over the routed expert FFNs).
+            targets = (("wq", "wk", "wv", "wo")
+                       if getattr(cfg, "num_experts", 0) > 1
+                       else ("wq", "wk", "wv", "wo", "wg", "wu", "wd"))
+            self.lora = LoraManager(
+                cfg, lora_dir=lora_dir, max_adapters=lora_max_adapters,
+                rank_cap=lora_rank_cap, targets=targets,
+            )
+            pool_leaves = self.lora.init_pool_leaves(np.dtype(cfg.dtype))
+            params = {**params, **pool_leaves}
+            log.info(
+                "lora: pool of %d adapter slots at rank cap %d over %s "
+                "(%d adapter(s) discovered in %s)",
+                self.lora.max_adapters, self.lora.rank_cap,
+                "/".join(targets), len(self.lora.available), lora_dir,
+            )
         shardings = self.family.param_shardings(cfg, self.mesh)
         self.params = {
             k: jax.device_put(v, shardings[k]) for k, v in params.items()
         }
+        if self.lora is not None:
+            self.lora.attach(self)
         if self.quant.weights:
             log.info(
                 "weights: int8 per-output-channel (%d quantized leaves), "
@@ -647,6 +705,12 @@ class EngineCore:
         # sample_tokens — unseeded rows are bit-identical to the pre-seed
         # path, so goldens hold.
         self._d_seeds = jnp.full((num_slots,), -1, jnp.int32)
+        # Per-slot LoRA adapter pool rows (0 = identity/no adapter),
+        # scattered at activation like the sampling params so the decode
+        # hot loop does zero per-step H2D. Only consulted when self.lora
+        # is set — LoRA-free engines pass lora_idx=None to every dispatch
+        # (the original compiled programs, bit for bit).
+        self._d_lora_idx = jnp.zeros((num_slots,), jnp.int32)
         self._key = jax.random.PRNGKey(seed)
 
         # Grammar-constraint mask: one float32 [slots, V] additive bias
@@ -787,6 +851,8 @@ class EngineCore:
         # minimum-bucket rounding) instead of paying each path a full budget.
         self._prefill_spent_iter = 0
         self.metrics = EngineMetrics()
+        if self.lora is not None:
+            self.lora.metrics = self.metrics
         # Step introspection (engine/stepstats.py): per-step phase records,
         # slow-step anomalies, and the sliding decode window live MFU math
         # reads. Always on — the recorder is a few clock reads per step
@@ -797,12 +863,14 @@ class EngineCore:
         self._pending_plan_s = 0.0
         # static per-token cost base for perf_info(): parameter count of the
         # served model (device arrays are cheap to .size). Scale leaves are
-        # bookkeeping, not parameters — excluded from the FLOP count; the
-        # measured byte footprint (param_bytes) includes them so the HBM
-        # accounting stays honest under int8 weights.
+        # bookkeeping, not parameters — excluded from the FLOP count, as are
+        # the LoRA pool leaves (mostly-empty adapter slots; the rank-R delta
+        # FLOPs are noise next to the base matmuls); the measured byte
+        # footprint (param_bytes) includes both so the HBM accounting stays
+        # honest under int8 weights and resident adapters.
         self.n_params = sum(
             int(v.size) for k, v in self.params.items()
-            if not k.endswith("_scale")
+            if not (k.endswith("_scale") or "_lora_" in k)
         )
         self.param_bytes = sum(
             int(v.size) * jnp.dtype(v.dtype).itemsize
@@ -943,8 +1011,10 @@ class EngineCore:
     def submit(self, request: Request) -> Request:
         n = len(request.prompt_ids)
         if n == 0:
+            self._release_lora(request)  # service may have pre-pinned
             raise ValueError("prompt must contain at least one token")
         if not self.prefill_buckets:
+            self._release_lora(request)
             raise ValueError(
                 "engine has no prefill buckets (slot capacity smaller than "
                 "every configured bucket)"
@@ -952,10 +1022,20 @@ class EngineCore:
         # Prompts beyond the largest one-shot bucket run through chunked
         # prefill (prefill_extend_slots); the only hard cap is slot capacity.
         if n + 1 >= self.slot_capacity:
+            # a refused submit must not leak a pin the service layer's
+            # prepare_lora already took for this request
+            self._release_lora(request)
             raise ValueError(
                 f"prompt of {n} tokens does not fit the slot capacity "
                 f"({self.slot_capacity}) with room to generate"
             )
+        # LoRA: pin (and hot-load) the adapter BEFORE the request can reach
+        # a slot — the step loop must never block on disk I/O, and eviction
+        # must see queued/parked requests as active. Idempotent: the service
+        # layer may have prepared off-loop already. Raises ValueError for
+        # unknown/invalid adapters (the server maps it to a 400 naming the
+        # 'lora' field).
+        self.prepare_lora(request)
         with self._lock:
             self.total_requests += 1
         if self.coordinator is not None:
@@ -965,6 +1045,37 @@ class EngineCore:
         else:
             self.pending.put(request)
         return request
+
+    def prepare_lora(self, request: Request) -> None:
+        """Resolve + pin a request's adapter (hot-loading it if cold).
+        Callable off-loop (service layer) or from submit; idempotent per
+        request. Raises ValueError when the request names an adapter this
+        engine cannot serve."""
+        name = request.sampling.lora
+        if not name:
+            return
+        if self.lora is None:
+            raise ValueError(
+                "'lora' adapters are not enabled on this engine "
+                "(start it with --lora-dir)"
+            )
+        self.lora.acquire(name, request.request_id)
+
+    def _release_lora(self, request: Request) -> None:
+        """Unpin a request's adapter at its terminal event (idempotent —
+        some paths fire twice for one request). Every site that records
+        record_request_done pairs with one of these."""
+        if self.lora is not None and request.sampling.lora:
+            self.lora.release(request.request_id)
+
+    def _lora_rows(self, requests) -> "np.ndarray":
+        """Adapter pool rows for an ordered request list — the per-row
+        index vector a prefill dispatch carries (activation then scatters
+        the same rows into the per-slot device mirror)."""
+        return np.asarray(
+            [self.lora.slot_of(r.sampling.lora) for r in requests],
+            np.int32,
+        )
 
     def stats(self) -> EngineStats:
         active = sum(1 for s in self.slots if s.request is not None)
@@ -1029,6 +1140,7 @@ class EngineCore:
             if req.cancelled:
                 req.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
+                self._release_lora(req)
                 continue
             if req.deadline_expired():
                 # deadline shedding must be deterministic across hosts, so
@@ -1037,11 +1149,13 @@ class EngineCore:
                 req.events.put(("error", "deadline exceeded before prefill"))
                 self.metrics.record_request_done("error")
                 self.metrics.record_deadline_shed()
+                self._release_lora(req)
                 continue
             n = len(req.prompt_ids)
             if n > budget:
                 req.events.put(("error", "prompt too large for a tick plan"))
                 self.metrics.record_request_done("error")
+                self._release_lora(req)
                 continue
             if tokens + n > budget:
                 # spill THIS and everything behind it to the next tick's
@@ -1156,6 +1270,7 @@ class EngineCore:
         for request in flushed:
             request.events.put(("error", "engine draining"))
             self.metrics.record_request_done("error")
+            self._release_lora(request)
         if flushed:
             log.info("drain flushed %d queued request(s)", len(flushed))
 
@@ -1369,6 +1484,7 @@ class EngineCore:
         request.finished_at = time.monotonic()
         request.events.put(("done", reason))
         self.metrics.record_request_done(reason)
+        self._release_lora(request)
         self._cancelled_effective.discard(request.request_id)
         self._release_cache_entry(slot)
         self._free_slot_kv(slot_id)
@@ -1447,6 +1563,7 @@ class EngineCore:
         request.events.put(("error", "deadline exceeded before prefill"))
         self.metrics.record_request_done("error")
         self.metrics.record_deadline_shed()
+        self._release_lora(request)
         return True
 
     def _prefill_budget_now(self) -> int:
@@ -1626,6 +1743,7 @@ class EngineCore:
                     request.finished_at = time.monotonic()
                     request.events.put(("done", "length"))
                     self.metrics.record_request_done("length")
+                    self._release_lora(request)
                     self._cancelled_effective.discard(request.request_id)
                     self._free_slot_kv(i)
                     if slot.constraint is not None:
@@ -1701,6 +1819,7 @@ class EngineCore:
             if self._is_cancelled(request):
                 request.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
+                self._release_lora(request)
                 self._cancelled_effective.discard(request.request_id)
                 handled = True
                 continue
@@ -1720,12 +1839,14 @@ class EngineCore:
                     request.finished_at = time.monotonic()
                     request.events.put(("done", "length"))
                     self.metrics.record_request_done("length")
+                    self._release_lora(request)
                     handled = True
                     continue
                 request.events.put(
                     ("error", "prompt does not fit slot capacity")
                 )
                 self.metrics.record_request_done("error")
+                self._release_lora(request)
                 handled = True
                 continue
             try:
@@ -1733,6 +1854,7 @@ class EngineCore:
             except Exception as e:
                 request.events.put(("error", f"constraint rejected: {e}"))
                 self.metrics.record_request_done("error")
+                self._release_lora(request)
                 handled = True
                 continue
             if (budget and batch_tokens + min(n, long_cutoff) > budget
@@ -1753,8 +1875,12 @@ class EngineCore:
                     and n - 1 >= self.min_prefix_len):
                 # Longest cached prefix, capped at n-1 (at least one suffix
                 # token must prefill to produce the first sampled logits).
+                # Namespaced by adapter id (docs/lora.md): under LoRA the
+                # prompt KV depends on the adapter's wq/wk/wv deltas, so an
+                # adapter-blind hit would be silent corruption.
                 hit = self.prefix_cache.match(request.prompt_ids,
-                                             max_len=n - 1)
+                                              max_len=n - 1,
+                                              ns=request.sampling.lora)
                 if hit is not None and not self._prefer_cp_over(hit[1], n):
                     entry, use_len = hit
                     fresh: list[int] | None = None
@@ -1844,7 +1970,10 @@ class EngineCore:
         slot = self.slots[slot_id]
         if self._use_cp_prefill and hasattr(
             self.family, "make_context_parallel_prefill"
-        ):
+        ) and not (self.lora is not None and request.sampling.lora):
+            # LoRA requests take the chunked path even on an sp>1 mesh: the
+            # ring-attention prefill closure carries no adapter indices (a
+            # sharded bgmv inside shard_map is future work — docs/lora.md)
             # Ring-attention prefill: one distributed pass over the mesh
             # sp axis fills the whole prompt's KV (per-chip sequence cost
             # ~n/sp), then scatters into the slot row.
@@ -2134,10 +2263,11 @@ class EngineCore:
         if self.page_pool is not None:
             def run(params, ids, chunk_lens, start_pos, tables,
                     cache_k, cache_v, temps, top_ps, top_ks, seeds, mask,
-                    key):
+                    key, lora_idx=None):
                 logits, cache_k, cache_v = family.verify_step_paged(
                     params, cfg, ids, chunk_lens, start_pos, tables,
                     cache_k, cache_v, mesh, window=window,
+                    lora_idx=lora_idx,
                 )
                 toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
                                      seeds, mask, start_pos)
@@ -2147,11 +2277,12 @@ class EngineCore:
             return jax.jit(run, donate_argnums=(5, 6))
 
         def run(params, ids, chunk_lens, start_pos,
-                cache_k, cache_v, temps, top_ps, top_ks, seeds, mask, key):
+                cache_k, cache_v, temps, top_ps, top_ks, seeds, mask, key,
+                lora_idx=None):
             slot_ids = jnp.arange(ids.shape[0], dtype=jnp.int32)
             logits, cache_k, cache_v = family.verify_step(
                 params, cfg, ids, chunk_lens, start_pos, slot_ids,
-                cache_k, cache_v, mesh, window=window,
+                cache_k, cache_v, mesh, window=window, lora_idx=lora_idx,
             )
             toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
                                  seeds, mask, start_pos)
@@ -2241,13 +2372,14 @@ class EngineCore:
         # slots' first tokens never round-tripped through the host
         ids_dev = jnp.asarray(ids).at[:, 0].set(self._d_last_tokens)
         fn = self._verify_for(window)
+        lora_idx = self._d_lora_idx if self.lora is not None else None
         if self.page_pool is not None:
             toks_dev, self.cache_k, self.cache_v = fn(
                 self.params, ids_dev, jnp.asarray(chunk_lens),
                 jnp.asarray(start_pos), self._d_block_tables,
                 self.cache_k, self.cache_v,
                 self._d_temps, self._d_top_ps, self._d_top_ks,
-                self._d_seeds, mask, sk,
+                self._d_seeds, mask, sk, lora_idx=lora_idx,
             )
         else:
             toks_dev, self.cache_k, self.cache_v = fn(
@@ -2255,7 +2387,7 @@ class EngineCore:
                 jnp.asarray(start_pos),
                 self.cache_k, self.cache_v,
                 self._d_temps, self._d_top_ps, self._d_top_ks,
-                self._d_seeds, mask, sk,
+                self._d_seeds, mask, sk, lora_idx=lora_idx,
             )
         t_compute = time.perf_counter()
         jax.block_until_ready(toks_dev)
@@ -2358,6 +2490,14 @@ class EngineCore:
             ),
         }
 
+    def lora_info(self) -> dict:
+        """Multi-LoRA block for /api/system, /api/health, and /metrics
+        consumers: pool config + live residency/eviction figures
+        (docs/lora.md)."""
+        if self.lora is None:
+            return {"enabled": False}
+        return self.lora.info()
+
     def _release_cache_entry(self, slot: _Slot) -> None:
         if slot.cache_entry is not None:
             if self.prefix_cache is not None:
@@ -2391,8 +2531,11 @@ class EngineCore:
         if length < cache.min_len:
             return
         tokens = tuple(request.prompt_ids[:length])
-        if cache.covers(tokens):
-            cache.touch(tokens)  # a re-served prefix is a use: refresh LRU
+        # Donations are namespaced by adapter id like matches: two adapters
+        # sharing a prompt text donate to DISJOINT trees (docs/lora.md).
+        ns = request.sampling.lora
+        if cache.covers(tokens, ns):
+            cache.touch(tokens, ns)  # a re-served prefix is a use: refresh LRU
             return
         # A longer prefix subsumes its ancestors (any match they could serve
         # routes through this entry's subtree) — reclaim their donor slots
@@ -2401,7 +2544,7 @@ class EngineCore:
         # multi-turn traffic this fires once per turn — charging it to
         # evictions_total would make the donor-churn signal operators alert
         # on track plain insertion rate.
-        for stale in cache.evict_subsumed_entries(tokens):
+        for stale in cache.evict_subsumed_entries(tokens, ns):
             self._release_entry_pages(stale)
         if len(cache) >= cache.max_entries and not self._evict_one_prefix():
             return
@@ -2411,13 +2554,13 @@ class EngineCore:
             )
             if not pages:
                 return
-            if cache.insert(tokens, -1, pages=pages) is not None:
+            if cache.insert(tokens, -1, pages=pages, ns=ns) is not None:
                 for p in pages:  # the cache is now a co-owner of the head
                     self.page_pool.ref(p)
                 self._prefix_pinned_pages += len(pages)
                 self.metrics.record_prefix_insert(length)
             return
-        if cache.insert(tokens, slot_id) is not None:
+        if cache.insert(tokens, slot_id, ns=ns) is not None:
             self.metrics.record_prefix_insert(length)
 
     def prefix_cache_info(self) -> dict:
@@ -2620,6 +2763,16 @@ class EngineCore:
         ids[g:] = ids[g - 1]
         lens[g:] = lens[g - 1]
         slot_ids[g:] = slot_ids[g - 1]
+        # Per-row adapter indices (docs/lora.md): a mixed-adapter group
+        # prefills in this ONE dispatch — the bgmv delta gathers each row's
+        # factors by index, no per-adapter sub-batching. Padding rows repeat
+        # the last real row like everything else.
+        lora_idx = None
+        if self.lora is not None:
+            lidx = np.zeros((padded,), np.int32)
+            lidx[:g] = self._lora_rows([r for _, r, _ in group])
+            lidx[g:] = lidx[g - 1]
+            lora_idx = jnp.asarray(lidx)
 
         prefill_start = time.monotonic()
         self._note_prefill_dispatch()
@@ -2636,6 +2789,7 @@ class EngineCore:
                 self.cache_k,
                 self.cache_v,
                 self.mesh,
+                lora_idx=lora_idx,
             )
         else:
             logits, self.cache_k, self.cache_v = self.family.prefill_into_slots(
@@ -2647,6 +2801,7 @@ class EngineCore:
                 self.cache_k,
                 self.cache_v,
                 self.mesh,
+                lora_idx=lora_idx,
             )
         t_compute = time.perf_counter()
         # jitted prefill returns futures (async dispatch); block before timing
@@ -2727,6 +2882,15 @@ class EngineCore:
         self._d_top_ps = self._d_top_ps.at[idx].set(d_top_ps)
         self._d_top_ks = self._d_top_ks.at[idx].set(d_top_ks)
         self._d_seeds = self._d_seeds.at[idx].set(d_seeds)
+        if self.lora is not None:
+            # adapter rows ride the same activation scatter as the sampling
+            # params: the decode hot loop then needs zero per-step H2D
+            lidx = np.zeros((padded,), np.int32)
+            lidx[:len(group)] = self._lora_rows([r for _, r, _ in group])
+            lidx[len(group):] = lidx[len(group) - 1]
+            self._d_lora_idx = self._d_lora_idx.at[idx].set(
+                jnp.asarray(lidx)
+            )
         self._d_seq_lens = self._d_seq_lens.at[idx].set(
             jnp.asarray(padded_lens)
         )
@@ -2860,6 +3024,8 @@ class EngineCore:
         bucket = self._bucket_for(chunk_len)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :chunk_len] = prompt[start:start + chunk_len]
+        lora_idx = (jnp.asarray(self._lora_rows([request]))
+                    if self.lora is not None else None)
 
         prefill_start = time.monotonic()
         self._note_prefill_dispatch()
@@ -2875,6 +3041,7 @@ class EngineCore:
                 self.cache_k,
                 self.cache_v,
                 self.mesh,
+                lora_idx=lora_idx,
             )
         else:
             logits, self.cache_k, self.cache_v = self.family.prefill_extend_slots(
@@ -2887,6 +3054,7 @@ class EngineCore:
                 self.cache_k,
                 self.cache_v,
                 self.mesh,
+                lora_idx=lora_idx,
             )
         t_compute = time.perf_counter()
         jax.block_until_ready(logits)  # async dispatch; time real execution
@@ -2940,14 +3108,14 @@ class EngineCore:
 
         if self.page_pool is not None:
             def many(params, last, lens, cache_k, cache_v, tables,
-                     temps, top_ps, top_ks, seeds, key):
+                     temps, top_ps, top_ks, seeds, key, lora_idx=None):
                 keys = jax.random.split(key, k)
 
                 def body(carry, step_key):
                     last, lens, ck, cv = carry
                     logits, ck, cv = family.decode_step_paged(
                         params, cfg, last, lens, ck, cv, tables, mesh,
-                        window=window,
+                        window=window, lora_idx=lora_idx,
                     )
                     toks = sample_tokens(logits, step_key, temps, top_ps,
                                          top_ks, None, seeds, lens)
@@ -2963,13 +3131,14 @@ class EngineCore:
             return jax.jit(many, donate_argnums=(3, 4))
 
         def many(params, last, lens, cache_k, cache_v,
-                 temps, top_ps, top_ks, seeds, key):
+                 temps, top_ps, top_ks, seeds, key, lora_idx=None):
             keys = jax.random.split(key, k)
 
             def body(carry, step_key):
                 last, lens, ck, cv = carry
                 logits, ck, cv = family.decode_step(
-                    params, cfg, last, lens, ck, cv, mesh, window=window
+                    params, cfg, last, lens, ck, cv, mesh, window=window,
+                    lora_idx=lora_idx,
                 )
                 toks = sample_tokens(logits, step_key, temps, top_ps, top_ks,
                                      None, seeds, lens)
@@ -3053,6 +3222,7 @@ class EngineCore:
         )
         if k > 1 and constrained_active:
             k = 1
+        lora_idx = self._d_lora_idx if self.lora is not None else None
         if k > 1:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
@@ -3063,7 +3233,7 @@ class EngineCore:
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v, self._d_block_tables,
                     self._d_temps, self._d_top_ps, self._d_top_ks,
-                    self._d_seeds, sk,
+                    self._d_seeds, sk, lora_idx=lora_idx,
                 )
             else:
                 (self._d_last_tokens, self._d_seq_lens, self.cache_k,
@@ -3071,7 +3241,7 @@ class EngineCore:
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v,
                     self._d_temps, self._d_top_ps, self._d_top_ks,
-                    self._d_seeds, sk,
+                    self._d_seeds, sk, lora_idx=lora_idx,
                 )
             t_compute = time.perf_counter()
             # split device execution from the D2H readback: the dispatch
@@ -3113,6 +3283,7 @@ class EngineCore:
                 self._d_block_tables,
                 self.mesh,
                 window=self._window_for(active, 1),
+                lora_idx=lora_idx,
             )
         else:
             logits, self.cache_k, self.cache_v = self.family.decode_step(
@@ -3124,6 +3295,7 @@ class EngineCore:
                 self.cache_v,
                 self.mesh,
                 window=self._window_for(active, 1),
+                lora_idx=lora_idx,
             )
         dispatch_s = time.perf_counter() - t_dispatch
         t_mask = time.perf_counter()
@@ -3201,6 +3373,7 @@ class EngineCore:
             request.finished_at = time.monotonic()
             request.events.put(("done", "cancelled"))
             self.metrics.record_request_done("cancelled")
+            self._release_lora(request)
             self._cancelled_effective.discard(request.request_id)
             self._free_slot_kv(slot_id)
             self._clear_constraint(slot_id)
@@ -3268,6 +3441,7 @@ class EngineCore:
             request.finished_at = time.monotonic()
             request.events.put(("done", finish))
             self.metrics.record_request_done(finish)
+            self._release_lora(request)
             if self.prefix_cache is not None:
                 # Donor retention: the freed slot's rows [0, prompt_len) hold
                 # exactly the prompt's KV — pin them for prefix reuse instead
@@ -3290,6 +3464,7 @@ class EngineCore:
             if slot.request is not None:
                 slot.request.events.put(("error", message))
                 self.metrics.record_request_done("error")
+                self._release_lora(slot.request)
                 slot.request = None
             self._release_cache_entry(slot)
             self._free_slot_kv(slot_id)
@@ -3308,15 +3483,20 @@ class EngineCore:
         if self._held_request is not None:
             self._held_request.events.put(("error", message))
             self.metrics.record_request_done("error")
+            self._release_lora(self._held_request)
             self._held_request = None
         for p in PRIORITY_CLASSES:
             q = self._class_queues[p]
             while q:
-                q.popleft().events.put(("error", message))
+                r = q.popleft()
+                r.events.put(("error", message))
                 self.metrics.record_request_done("error")
+                self._release_lora(r)
         while True:
             try:
-                self.pending.get_nowait().events.put(("error", message))
+                r = self.pending.get_nowait()
+                r.events.put(("error", message))
                 self.metrics.record_request_done("error")
+                self._release_lora(r)
             except queue.Empty:
                 break

@@ -379,6 +379,15 @@ class EngineAPI:
             caps.append("prefill")
         if role in ("both", "split", "decode"):
             caps.append("decode")
+        # Multi-LoRA (docs/lora.md): "lora" on the BASE entry means "this
+        # endpoint can hot-load any adapter in its store"; each RESIDENT
+        # adapter additionally advertises as its own model entry
+        # `base:adapter`, so the gateway's model sync routes adapter
+        # traffic to endpoints where it is already hot and falls back to
+        # any lora-capable endpoint (triggering a hot-load) before 404ing.
+        lora_mgr = self.engine.core.lora
+        if lora_mgr is not None:
+            caps.append("lora")
 
         def entry(model_id: str, caps: list[str]) -> dict:
             return {
@@ -394,6 +403,15 @@ class EngineAPI:
         main_entry = entry(self.engine.model_id, caps)
         main_entry["role"] = role
         data = [main_entry]
+        if lora_mgr is not None:
+            for name in lora_mgr.resident_names():
+                adapter_entry = entry(
+                    f"{self.engine.model_id}:{name}",
+                    [c for c in caps if c != "embeddings"],
+                )
+                adapter_entry["role"] = role
+                adapter_entry["lora"] = name
+                data.append(adapter_entry)
         if self.asr is not None:
             data.append(entry(self.asr.model_id, ["audio_transcription"]))
         if self.tts is not None:
@@ -554,7 +572,7 @@ class EngineAPI:
             num_slots=stats.num_slots, prefix_cache=core.prefix_cache_info(),
             kv_cache=core.kv_cache_info(), structured=core.structured_info(),
             perf=core.perf_info(), quant=core.quant_info(),
-            sched=core.sched_info(),
+            sched=core.sched_info(), lora=core.lora_info(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -580,6 +598,8 @@ class EngineAPI:
                 "sched": self.engine.core.sched_info(),
                 # disaggregated prefill/decode: role + handoff counters
                 "disagg": self.engine.core.disagg_info(),
+                # multi-LoRA adapter pool (docs/lora.md)
+                "lora": self.engine.core.lora_info(),
                 # graceful drain state (docs/deployment.md)
                 "draining": self.drain.info(),
                 # live roofline: MFU / HBM-bandwidth utilization against the
@@ -753,7 +773,37 @@ class EngineAPI:
         if structured is not None:
             sampling.constraint = structured.spec
         tool_name = structured.tool_name if structured is not None else None
+        # Multi-LoRA (docs/lora.md): adapter via the `lora` field or the
+        # `model:adapter` suffix (suffix considered only on LoRA-enabled
+        # engines — a colon in a model name stays inert otherwise).
+        # Unknown/invalid adapters 400 here with the field named, before a
+        # stream response could start.
+        adapter, base = self._parse_lora(body)
+        if adapter is not None:
+            sampling.lora = adapter
+            model = base or model
         return prompt_ids, sampling, _stops_from(body), tool_name, model
+
+    def _parse_lora(self, body: dict) -> tuple[str | None, str | None]:
+        """(adapter, base_model) from a request body, validated against this
+        engine's adapter store. Raises ValueError naming the `lora` field —
+        the shared contract with the gateway's inspect path
+        (llmlb_tpu/lora/api.py)."""
+        from llmlb_tpu.lora import adapter_from_body
+
+        core = self.engine.core
+        if body.get("lora") is None and core.lora is None:
+            return None, None
+        if core.lora is None:
+            raise ValueError(
+                "'lora' adapters are not enabled on this engine "
+                "(start it with --lora-dir)"
+            )
+        base, adapter = adapter_from_body(body)
+        if adapter is None:
+            return None, None
+        core.lora.validate(adapter)
+        return adapter, base
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -1116,6 +1166,10 @@ class EngineAPI:
         prompt_ids = self.engine.tokenizer.encode(prompt)
         sampling = _sampling_from(body, default_max=16)
         sampling.deadline_ms = _deadline_from(request)  # middleware 400s bad values
+        adapter, base = self._parse_lora(body)  # middleware 400s bad values
+        if adapter is not None:
+            sampling.lora = adapter
+            model = base or model
         stops = _stops_from(body)
         completion_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -1501,6 +1555,26 @@ def main(argv: list[str] | None = None) -> None:
              "the remaining slots form the decode pool",
     )
     parser.add_argument(
+        "--lora-dir", default=None,
+        help="directory of LoRA adapters (one PEFT-layout subdirectory per "
+             "adapter; also via LLMLB_LORA_DIR). Enables multi-LoRA "
+             "serving: per-request adapters via the 'lora' field or a "
+             "'model:adapter' name, batched mixed-adapter decode, LRU "
+             "hot-load/evict (docs/lora.md). Default off",
+    )
+    parser.add_argument(
+        "--lora-max-adapters", type=int, default=None,
+        help="device-resident adapter pool slots (default 8; also via "
+             "LLMLB_LORA_MAX_ADAPTERS) — adapters beyond this LRU-evict "
+             "when idle; HBM cost scales linearly (docs/lora.md)",
+    )
+    parser.add_argument(
+        "--lora-rank-cap", type=int, default=None,
+        help="max adapter rank the pool holds (default 16; also via "
+             "LLMLB_LORA_RANK_CAP) — higher-rank adapters are refused "
+             "with a 400; lower ranks zero-pad exactly",
+    )
+    parser.add_argument(
         "--prefix-cache", choices=("on", "off"), default=None,
         help="radix-tree prefix KV reuse across requests (default on; "
              "also via LLMLB_PREFIX_CACHE=0)",
@@ -1559,6 +1633,12 @@ def main(argv: list[str] | None = None) -> None:
         extra["role"] = args.role
     if args.disagg_prefill_slots is not None:
         extra["disagg_prefill_slots"] = max(1, args.disagg_prefill_slots)
+    if args.lora_dir is not None:
+        extra["lora_dir"] = args.lora_dir
+    if args.lora_max_adapters is not None:
+        extra["lora_max_adapters"] = max(1, args.lora_max_adapters)
+    if args.lora_rank_cap is not None:
+        extra["lora_rank_cap"] = max(1, args.lora_rank_cap)
     if args.prefix_cache is not None:
         extra["prefix_cache"] = args.prefix_cache == "on"
     if args.prefix_cache_slots is not None:

@@ -179,7 +179,22 @@ class Engine:
                 self.constraint_compiler.compile_spec,
                 sampling.constraint,
             )
-        self.core.submit(request)
+        if sampling.lora:
+            # Pin + hot-load the adapter off the event loop (first use reads
+            # safetensors from disk) AND off the step loop; submit's own
+            # prepare_lora call is then an idempotent lookup.
+            await loop.run_in_executor(
+                self._executor, self.core.prepare_lora, request
+            )
+        try:
+            self.core.submit(request)
+        except BaseException:
+            # a pre-pinned adapter must not leak when the submit never
+            # reaches a queue (validation refusal, or cancellation landing
+            # between the prepare above and here); idempotent no-op when
+            # nothing was acquired
+            self.core._release_lora(request)
+            raise
 
         detok = IncrementalDetokenizer(self.tokenizer)
         stop = [s for s in (stop or []) if s]
@@ -280,7 +295,15 @@ class Engine:
                 self.constraint_compiler.compile_spec,
                 sampling.constraint,
             )
-        self.core.submit(request)
+        if sampling.lora:
+            await loop.run_in_executor(
+                self._executor, self.core.prepare_lora, request
+            )
+        try:
+            self.core.submit(request)
+        except BaseException:
+            self.core._release_lora(request)  # see stream(): no pin leaks
+            raise
         committed: list[int] = []
         finish: str | None = None
         try:
@@ -421,7 +444,17 @@ class Engine:
                 constraint=cursor, drafter=drafter, spec_k=spec_k,
             ),
         )
-        core.submit(request)
+        if sampling.lora:
+            # adoption replays prompt+committed WITH the adapter — the
+            # resumed continuation must read the same wq/wk/wv deltas
+            await loop.run_in_executor(
+                self._executor, core.prepare_lora, request
+            )
+        try:
+            core.submit(request)
+        except BaseException:
+            core._release_lora(request)  # see stream(): no pin leaks
+            raise
         try:
             while True:
                 kind, value = await loop.run_in_executor(
@@ -591,6 +624,9 @@ class Engine:
             # health probe re-reads `role` from here every interval, so a
             # restarted engine that changed role re-routes within one probe
             "disagg": self.core.disagg_info(),
+            # multi-LoRA adapter pool: resident/available adapters,
+            # load/evict counters (docs/lora.md)
+            "lora": self.core.lora_info(),
             # live roofline (MFU / HBM-BW vs chip peaks, docs/profiling.md);
             # the gateway's telemetry-aware placement can read how close to
             # the hardware each engine is running

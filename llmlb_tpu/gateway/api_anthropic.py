@@ -166,7 +166,11 @@ def anthropic_request_to_openai(body: dict) -> dict:
                      ("speculative", "speculative"),
                      # priority class (docs/scheduling.md): high/normal/low
                      # or 0..2, carried verbatim — the engine validates
-                     ("priority", "priority")):
+                     ("priority", "priority"),
+                     # LoRA adapter name (docs/lora.md): carried verbatim —
+                     # the shared validator (llmlb_tpu/lora/api.py) runs at
+                     # the gateway's inspect step and again at the engine
+                     ("lora", "lora")):
         if body.get(src) is not None:
             out[dst] = body[src]
     if body.get("stop_sequences"):
@@ -428,8 +432,24 @@ async def messages(request: web.Request) -> web.StreamResponse:
             canonical, Capability.STRUCTURED_OUTPUTS
         ):
             capability = Capability.STRUCTURED_OUTPUTS
+    # Multi-LoRA routing (docs/lora.md) — same resolution and 400 shape
+    # contract as proxy_openai_post, refusals in the Anthropic error shape.
+    from llmlb_tpu.lora.gateway import lora_route_for
+
+    try:
+        lora_route = lora_route_for(state, openai_body)
+    except ValueError as e:
+        state.metrics.record_lora_route("rejected")
+        return _anthropic_error(400, str(e))
+    if lora_route is not None:
+        canonical = lora_route.canonical
+        state.metrics.record_lora_route(lora_route.kind)
+        if lora_route.capability is not None:
+            capability = lora_route.capability
     prefix_hash = prefix_affinity_hash(
-        canonical, affinity_text_from_body(body)
+        lora_route.base_canonical if lora_route is not None else canonical,
+        affinity_text_from_body(body),
+        lora=lora_route.adapter if lora_route is not None else None,
     )
     is_stream = bool(body.get("stream"))
     if is_stream:
@@ -518,6 +538,13 @@ async def messages(request: web.Request) -> web.StreamResponse:
             )
         endpoint, engine_model, lease, chosen_model = selection
         openai_body["model"] = engine_model
+        if lora_route is not None:
+            from llmlb_tpu.lora.gateway import forward_model_name
+
+            openai_body["model"] = forward_model_name(
+                lora_route, engine_model, lora_route.base_canonical
+            )
+            openai_body["lora"] = lora_route.adapter
 
         # Durable streams (gateway/replay.py): arm tpu:// engine streams so
         # a mid-stream engine death resumes token-identically elsewhere and

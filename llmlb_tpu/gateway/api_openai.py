@@ -518,12 +518,40 @@ async def proxy_openai_post(
     canonical = to_canonical(model)
     if trace is not None:
         trace.model = canonical
+    # Multi-LoRA routing (docs/lora.md): a `lora` field or `model:adapter`
+    # suffix steers to endpoints where the adapter is already HOT, falls
+    # back to any lora-capable endpoint (triggering a hot-load), and 400s
+    # naming the field when the fleet cannot serve the adapter — before a
+    # blind proxy could turn it into an engine-side error. Malformed
+    # values 400 here with the same message the engine would produce
+    # (shared validator, llmlb_tpu/lora/api.py).
+    lora_route = None
+    if capability == Capability.CHAT_COMPLETION:
+        from llmlb_tpu.lora.gateway import lora_route_for
+
+        try:
+            lora_route = lora_route_for(state, body)
+        except ValueError as e:
+            state.metrics.record_lora_route("rejected")
+            return error_response(400, str(e))
+        if lora_route is not None:
+            canonical = lora_route.canonical
+            state.metrics.record_lora_route(lora_route.kind)
+            if trace is not None:
+                trace.model = canonical
     # Affinity only for generation traffic: embeddings (and other non-chat
     # capabilities) never touch the engine's prefix KV cache, and hashing
     # their inputs would churn the shared affinity map and pin their routing
-    # for zero benefit.
+    # for zero benefit. The adapter id folds into the hash — under LoRA the
+    # prompt KV depends on the adapter, so two adapters sharing a system
+    # prompt must pin to caches independently (docs/lora.md).
     prefix_hash = (
-        prefix_affinity_hash(canonical, affinity_text_from_body(body))
+        prefix_affinity_hash(
+            lora_route.base_canonical if lora_route is not None
+            else canonical,
+            affinity_text_from_body(body),
+            lora=lora_route.adapter if lora_route is not None else None,
+        )
         if capability == Capability.CHAT_COMPLETION else None
     )
 
@@ -547,6 +575,12 @@ async def proxy_openai_post(
                 canonical, Capability.STRUCTURED_OUTPUTS
             ):
                 capability = Capability.STRUCTURED_OUTPUTS
+    if lora_route is not None and lora_route.capability is not None:
+        # cold-load route: only endpoints WITH an adapter store are
+        # eligible (a capability-blind pick would 400 at the engine).
+        # Wins over structured steering — tpu lora engines advertise
+        # structured_outputs too, so nothing is lost on a pure-TPU fleet.
+        capability = lora_route.capability
 
     client_ip = request.remote
     auth = request.get("auth")
@@ -650,6 +684,19 @@ async def proxy_openai_post(
         payload["model"] = engine_model or to_engine_name(
             canonical, endpoint.endpoint_type.value
         )
+        if lora_route is not None:
+            # the engine must see the adapter whichever route won: its own
+            # hot `base:adapter` entry, or `base:adapter` synthesized so a
+            # load-route engine hot-loads at admission; the explicit field
+            # rides along (both dialects accept either — they must agree)
+            from llmlb_tpu.lora.gateway import forward_model_name
+
+            payload["model"] = forward_model_name(
+                lora_route, engine_model,
+                to_engine_name(lora_route.base_canonical,
+                               endpoint.endpoint_type.value),
+            )
+            payload["lora"] = lora_route.adapter
         if is_stream:
             # usage in the final chunk feeds the TPS tracker
             # (api/openai.rs:981-992)

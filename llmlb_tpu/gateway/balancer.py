@@ -78,16 +78,21 @@ PREFIX_AFFINITY_CHARS = 512  # ≈ the first 128 prompt tokens
 PREFIX_AFFINITY_MIN_CHARS = 128
 
 
-def prefix_affinity_hash(model: str, text: str) -> str | None:
+def prefix_affinity_hash(model: str, text: str,
+                         lora: str | None = None) -> str | None:
     """Stable hash of a prompt's head (+ model, so two models' identical
-    system prompts don't collide onto one engine's cache). None for heads
-    too short to benefit from prefix reuse."""
+    system prompts don't collide onto one engine's cache; + LoRA adapter
+    id — under multi-LoRA the prompt KV depends on the adapter's wq/wk/wv
+    deltas, so two adapters sharing a system prompt must pin and warm
+    caches independently, docs/lora.md). None for heads too short to
+    benefit from prefix reuse. lora=None hashes exactly as before, so
+    adapter-free affinity keys are unchanged."""
     if len(text) < PREFIX_AFFINITY_MIN_CHARS:
         return None
     head = text[:PREFIX_AFFINITY_CHARS]
-    return hashlib.sha1(
-        f"{model}\x00{head}".encode("utf-8", "replace")
-    ).hexdigest()
+    key = (f"{model}\x00{head}" if lora is None
+           else f"{model}\x00lora={lora}\x00{head}")
+    return hashlib.sha1(key.encode("utf-8", "replace")).hexdigest()
 
 
 # Gossip: one TPS message per tracked key at most this often — the EMA moves

@@ -69,10 +69,24 @@ def _parse_telemetry(body: dict) -> AcceleratorInfo:
         drain_remaining = max(0.0, float(drain.get("remaining_s") or 0.0))
     except (TypeError, ValueError):
         drain_remaining = 0.0
+    # Multi-LoRA advertisement (docs/lora.md): resident adapter names,
+    # re-read every probe like the disagg role above.
+    lora = body.get("lora")
+    lora = lora if isinstance(lora, dict) else {}
+    lora_loaded = lora_available = None
+    if lora.get("enabled"):
+        lora_loaded = tuple(
+            str(n) for n in (lora.get("resident") or ())
+        )
+        lora_available = tuple(
+            str(n) for n in (lora.get("available") or ())
+        )
     return AcceleratorInfo(
         role=role if role in ROLES else None,
         draining=draining,
         drain_remaining_s=drain_remaining,
+        lora_loaded=lora_loaded,
+        lora_available=lora_available,
         accelerator=tpu.get("accelerator") or ("tpu" if "tpu" in body else None),
         chip_count=_as_int(tpu.get("chip_count")),
         hbm_used_bytes=_as_int(tpu.get("hbm_used_bytes")),
@@ -214,6 +228,7 @@ class EndpointHealthChecker:
                     self.resilience.note_probe(ep.id, True)
             if recovered:
                 await self._on_recovery(ep)
+            self._sync_lora_models(ep, result.accelerator)
         else:
             failures = ep.consecutive_failures + 1
             if prev_status == EndpointStatus.PENDING:
@@ -251,6 +266,38 @@ class EndpointHealthChecker:
                 {"endpoint_id": ep.id, "tpu": vars(result.accelerator)},
             )
         return result
+
+    def _sync_lora_models(self, ep: Endpoint, acc) -> None:
+        """Mirror a probe's resident-adapter advertisement into
+        `base:adapter` model entries (docs/lora.md). Model sync proper runs
+        only at registration/recovery, but adapters hot-load and evict at
+        request rate — this keeps find_by_model("base:adapter") fresh
+        within one probe interval, the disagg-role re-parse precedent.
+        No-op (and no DB churn) when the resident set is unchanged."""
+        if acc is None or acc.lora_loaded is None:
+            return
+        from llmlb_tpu.gateway.types import Capability, EndpointModel
+
+        models = self.registry.models_for(ep.id)
+        base = [m for m in models if ":" not in m.model_id]
+        lora_base = [m for m in base if Capability.LORA in m.capabilities]
+        if not lora_base:
+            return
+        wanted: dict[str, EndpointModel] = {}
+        for m in lora_base:
+            for name in acc.lora_loaded:
+                mid = f"{m.model_id}:{name}"
+                wanted[mid] = EndpointModel(
+                    endpoint_id=ep.id,
+                    model_id=mid,
+                    canonical_name=f"{m.canonical_name}:{name}",
+                    capabilities=list(m.capabilities),
+                    context_length=m.context_length,
+                )
+        current = {m.model_id for m in models if ":" in m.model_id}
+        if current == set(wanted):
+            return
+        self.registry.sync_models(ep.id, base + list(wanted.values()))
 
     async def _on_recovery(self, ep: Endpoint) -> None:
         """Re-detect type (it may have been swapped) and resync models."""

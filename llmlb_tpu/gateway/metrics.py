@@ -127,6 +127,12 @@ class GatewayMetrics:
         # at gateway-side validation (malformed / unsupported schema)
         self._structured_requests: dict[str, int] = defaultdict(int)
         self._structured_rejected = 0
+        # multi-LoRA adapter routing (docs/lora.md): requests that named an
+        # adapter, by route — "hot" (an endpoint already had it resident),
+        # "load" (fell back to a lora-capable endpoint, triggering a
+        # hot-load), "rejected" (400: malformed field or unserveable
+        # adapter)
+        self._lora_requests: dict[str, int] = defaultdict(int)
         # disaggregated prefill/decode (docs/disaggregation.md): two-phase
         # handoffs the proxy orchestrated, by outcome — "adopted" (a decode
         # pool endpoint took the stream) or "self" (no adopter free; the
@@ -232,6 +238,12 @@ class GatewayMetrics:
         """Gateway-side validation refused a structured request (400)."""
         with self._lock:
             self._structured_rejected += 1
+
+    def record_lora_route(self, route: str) -> None:
+        """One adapter-naming request routed: hot / load / rejected
+        (docs/lora.md)."""
+        with self._lock:
+            self._lora_requests[route] += 1
 
     def record_handoff(self, outcome: str) -> None:
         """One orchestrated prefill→decode handoff; outcome is "adopted"
@@ -353,6 +365,7 @@ class GatewayMetrics:
                 "structured_requests_total":
                     sum(self._structured_requests.values()),
                 "structured_rejected_total": self._structured_rejected,
+                "lora_requests_total": sum(self._lora_requests.values()),
                 "handoffs_total": sum(self._handoffs.values()),
                 "slo_eligible_total": sum(self._slo_eligible.values()),
                 "slo_met_total": sum(self._slo_met.values()),
@@ -476,6 +489,14 @@ class GatewayMetrics:
                 f"llmlb_gateway_structured_rejected_total "
                 f"{self._structured_rejected}"
             )
+            lines.append(
+                "# TYPE llmlb_gateway_lora_requests_total counter"
+            )
+            for route, n in sorted(self._lora_requests.items()):
+                lines.append(
+                    f'llmlb_gateway_lora_requests_total'
+                    f'{{route="{_escape(route)}"}} {n}'
+                )
             lines.append(
                 "# TYPE llmlb_gateway_handoffs_total counter"
             )

@@ -48,6 +48,13 @@ class Capability(str, enum.Enum):
     # ones. Engines running --role both/split advertise both.
     PREFILL = "prefill"
     DECODE = "decode"
+    # Multi-LoRA serving (docs/lora.md): a tpu:// engine started with
+    # --lora-dir advertises "lora" on its base model entry ("I can hot-load
+    # any adapter in my store") and one extra model entry per RESIDENT
+    # adapter (`base:adapter`). The balancer routes adapter traffic to
+    # endpoints where it is already hot and falls back to any lora-capable
+    # endpoint — triggering a hot-load — before 404ing.
+    LORA = "lora"
 
 
 class Role(str, enum.Enum):
@@ -111,6 +118,18 @@ class AcceleratorInfo:
     # every endpoint for a model is draining.
     draining: bool = False
     drain_remaining_s: float = 0.0
+    # Multi-LoRA advertisement from the engine's /api/health lora block
+    # (docs/lora.md): None when the endpoint does not serve adapters;
+    # otherwise the RESIDENT (hot) adapter names. Re-parsed every probe —
+    # the health checker mirrors it into `base:adapter` model entries so
+    # adapter routing sees hot-loads/evictions within one probe interval.
+    lora_loaded: tuple[str, ...] | None = None
+    # Every SERVABLE adapter in the endpoint's store (resident or not).
+    # Lets the gateway refuse an adapter NO endpoint could hot-load with a
+    # clean 400 naming the field, instead of proxying to a certain
+    # engine-side 400 (which the resilience layer normalizes to 502). An
+    # adapter dropped into a store propagates here within one probe.
+    lora_available: tuple[str, ...] | None = None
     sampled_at: float = 0.0  # when the probe captured this; 0 = never
 
     @property

@@ -1,0 +1,42 @@
+"""Multi-LoRA serving (docs/lora.md): hundreds of per-tenant adapters over
+one set of base weights.
+
+- `store`: adapter discovery + safetensors loading (HF/PEFT layout) into the
+  stacked host tensors the device pool rows take.
+- `manager`: the device-resident adapter pool — LRU hot-load/evict keyed like
+  the structured-outputs mask cache, refcounted so an adapter with active
+  requests is never evicted, slot 0 reserved as the all-zero identity row.
+- `api`: the request-surface contract shared by the gateway and the engine
+  server — `lora` field / `model:adapter` suffix parsing with one notion of
+  "valid", so both dialects 400 identically.
+
+The batched grouped matmul lives in ops/lora.py (bgmv Pallas kernel + XLA
+fallback); the model-side wiring is the `<name>_lora_a`/`<name>_lora_b`
+param companions in models/llama.py.
+"""
+
+from llmlb_tpu.lora.api import (
+    LORA_NAME_RE,
+    adapter_from_body,
+    split_model_adapter,
+)
+from llmlb_tpu.lora.manager import LoraManager
+from llmlb_tpu.lora.store import (
+    AdapterInfo,
+    discover_adapters,
+    load_adapter_tensors,
+    lora_target_dims,
+    save_adapter,
+)
+
+__all__ = [
+    "AdapterInfo",
+    "LORA_NAME_RE",
+    "LoraManager",
+    "adapter_from_body",
+    "discover_adapters",
+    "load_adapter_tensors",
+    "lora_target_dims",
+    "save_adapter",
+    "split_model_adapter",
+]

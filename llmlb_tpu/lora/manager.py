@@ -1,0 +1,301 @@
+"""Device-resident LoRA adapter pool: hot-load, LRU evict, refcounts.
+
+The manager owns the `<name>_lora_a` / `<name>_lora_b` param companions the
+model forward reads (stacked pools `[L, N+1, in, R]` / `[L, N+1, R, out]`):
+
+- Row 0 is the RESERVED all-zero identity adapter — adapter-free requests
+  carry index 0 and their delta is exactly 0.0, keeping them bit-identical
+  to a LoRA-free engine (the `test_quantize_off_bit_identical` contract).
+- Rows 1..N hold up to `max_adapters` resident adapters. A request's
+  adapter hot-loads on first use (disk → host stack → one device row write
+  per pool leaf) and is LRU-evicted only when NO request references it —
+  acquired at submit, released at the request's terminal event, so queued
+  and parked requests pin their adapter exactly like the PR 5 mask cache
+  pins compiled masks with in-flight readers.
+
+Thread-safety: acquire/release run on HTTP executor threads while the step
+loop dispatches. Manager bookkeeping sits under one lock; the device row
+writes are plain (non-donating) `at[].set` updates re-assigned into
+`core.params` — in-flight dispatches keep their already-flattened arrays,
+and no live request references a row mid-rewrite (eviction requires
+refcount 0, and the row's new owner is only submittable after the write
+returns).
+
+HBM math (docs/lora.md): one resident adapter at rank R costs
+`sum_targets L * R * (in + out) * 2 bytes` bf16 — ~56 MB for a llama-3-8b
+all-target R=16 adapter, which is why PR 8's int8 base weights are what
+make hundreds of resident adapters plausible.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from llmlb_tpu.lora.store import (
+    AdapterInfo,
+    discover_adapters,
+    load_adapter_tensors,
+    lora_target_dims,
+)
+
+log = logging.getLogger("llmlb_tpu.lora")
+
+_LORA_A = "_lora_a"
+_LORA_B = "_lora_b"
+
+
+class LoraManager:
+    """Adapter pool bookkeeping + the device pool leaves' single writer."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        lora_dir: str,
+        max_adapters: int = 8,
+        rank_cap: int = 16,
+        targets: tuple[str, ...] = ("wq", "wk", "wv", "wo", "wg", "wu", "wd"),
+        metrics=None,
+    ):
+        self.cfg = cfg
+        self.lora_dir = lora_dir
+        self.max_adapters = max(1, int(max_adapters))
+        self.rank_cap = max(1, int(rank_cap))
+        self.targets = tuple(targets)
+        self.metrics = metrics
+        self.core = None  # attached by EngineCore (owns the params dict)
+        self._lock = threading.RLock()
+        self.available: dict[str, AdapterInfo] = discover_adapters(
+            lora_dir, rank_cap=self.rank_cap, allowed_targets=self.targets
+        )
+        # name -> pool row (1-based; row 0 is the identity adapter)
+        self._resident: dict[str, int] = {}
+        self._free_rows = list(range(1, self.max_adapters + 1))
+        self._refcounts: dict[str, int] = {}
+        self._acquired: dict[str, str] = {}  # request token -> adapter name
+        self._last_used: dict[str, float] = {}
+        self.loads_total = 0
+        self.evictions_total = 0
+
+    # -------------------------------------------------------------- pool init
+
+    def init_pool_leaves(self, dtype) -> dict[str, np.ndarray]:
+        """The zero pool leaves merged into the engine's param pytree at
+        construction (sharded/placed with everything else). Host numpy —
+        EngineCore device_puts them with the rest of the params."""
+        dims = lora_target_dims(self.cfg, self.targets)
+        n = self.max_adapters + 1  # + identity row 0
+        layers = self.cfg.num_layers
+        leaves: dict[str, np.ndarray] = {}
+        for tgt, (in_dim, out_dim) in dims.items():
+            leaves[f"{tgt}{_LORA_A}"] = np.zeros(
+                (layers, n, in_dim, self.rank_cap), dtype
+            )
+            leaves[f"{tgt}{_LORA_B}"] = np.zeros(
+                (layers, n, self.rank_cap, out_dim), dtype
+            )
+        return leaves
+
+    def attach(self, core) -> None:
+        self.core = core
+
+    # ------------------------------------------------------------- validation
+
+    def rescan(self) -> None:
+        """Re-discover the adapter directory (new adapters appear without an
+        engine restart; resident/refcounted state is preserved)."""
+        with self._lock:
+            fresh = discover_adapters(
+                self.lora_dir, rank_cap=self.rank_cap,
+                allowed_targets=self.targets,
+            )
+            # resident adapters keep the info they were loaded from
+            for name in self._resident:
+                if name in self.available:
+                    fresh[name] = self.available[name]
+            self.available = fresh
+
+    def validate(self, name: str) -> AdapterInfo:
+        """The servable AdapterInfo for `name`, or ValueError whose message
+        names the `lora` field — the engine server maps it to a 400."""
+        with self._lock:
+            info = self.available.get(name)
+            if info is None:
+                self.rescan()
+                info = self.available.get(name)
+            if info is None:
+                known = ", ".join(sorted(self.available)) or "none"
+                raise ValueError(
+                    f"'lora' names unknown adapter {name!r} "
+                    f"(available: {known})"
+                )
+            if info.error is not None:
+                raise ValueError(
+                    f"'lora' adapter {name!r} is not servable: {info.error}"
+                )
+            return info
+
+    # --------------------------------------------------------- acquire/release
+
+    def acquire(self, name: str, token: str) -> int:
+        """Pin adapter `name` for request `token` and return its pool row,
+        hot-loading (and LRU-evicting) as needed. Idempotent per token.
+        Raises ValueError (unknown/invalid adapter, or pool exhausted by
+        active adapters) — the caller maps it to a client error."""
+        with self._lock:
+            prev = self._acquired.get(token)
+            if prev == name:
+                return self._resident[name]
+            if prev is not None:
+                self._release_name(prev)
+                del self._acquired[token]
+            info = self.validate(name)
+            row = self._ensure_resident(info)
+            self._acquired[token] = name
+            self._refcounts[name] = self._refcounts.get(name, 0) + 1
+            self._last_used[name] = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.record_lora_request(name)
+            return row
+
+    def release(self, token: str) -> None:
+        """Unpin whatever `token` acquired. Idempotent — terminal paths may
+        fire more than once for one request."""
+        with self._lock:
+            name = self._acquired.pop(token, None)
+            if name is not None:
+                self._release_name(name)
+
+    def _release_name(self, name: str) -> None:
+        n = self._refcounts.get(name, 0)
+        if n <= 1:
+            self._refcounts.pop(name, None)
+        else:
+            self._refcounts[name] = n - 1
+
+    def slot_of(self, name: str | None) -> int:
+        """Pool row of a RESIDENT adapter (0 for None — the identity row).
+        Callers hold a refcount via acquire, so the row cannot move.
+
+        Deliberately LOCK-FREE: the step loop calls this per dispatch while
+        an HTTP thread may hold the manager lock across a multi-second cold
+        hot-load (disk read + device writes) — taking the lock here would
+        stall every active stream behind that load. A plain GIL-atomic dict
+        read is safe: an adapter is published to `_resident` only AFTER its
+        rows are fully written, and the caller's refcount pins the entry."""
+        if not name:
+            return 0
+        row = self._resident.get(name)
+        if row is None:
+            raise KeyError(f"adapter {name!r} is not resident")
+        return row
+
+    # ------------------------------------------------------------ load / evict
+
+    def _ensure_resident(self, info: AdapterInfo) -> int:
+        """Lock held. Return the adapter's pool row, loading it (evicting an
+        idle LRU victim when the pool is full) if needed."""
+        row = self._resident.get(info.name)
+        if row is not None:
+            return row
+        if not self._free_rows:
+            victim = self._evict_lru_locked()
+            if victim is None:
+                active = sorted(self._refcounts)
+                raise ValueError(
+                    f"'lora' adapter pool exhausted: all {self.max_adapters} "
+                    f"resident adapters have active requests "
+                    f"({', '.join(active)}); retry shortly or raise "
+                    "--lora-max-adapters"
+                )
+        row = self._free_rows.pop(0)
+        t0 = time.monotonic()
+        self._write_rows(info, row)
+        self._resident[info.name] = row
+        self.loads_total += 1
+        took = time.monotonic() - t0
+        if self.metrics is not None:
+            self.metrics.record_lora_load(took)
+        log.info("lora: loaded adapter %r (rank %d, targets %s) into row %d "
+                 "in %.3fs", info.name, info.rank,
+                 "/".join(info.targets), row, took)
+        return row
+
+    def _evict_lru_locked(self) -> str | None:
+        victim: str | None = None
+        for name in self._resident:
+            if self._refcounts.get(name, 0) > 0:
+                continue
+            if victim is None or (self._last_used.get(name, 0.0)
+                                  < self._last_used.get(victim, 0.0)):
+                victim = name
+        if victim is None:
+            return None
+        row = self._resident.pop(victim)
+        self._free_rows.append(row)
+        self._last_used.pop(victim, None)
+        self.evictions_total += 1
+        if self.metrics is not None:
+            self.metrics.record_lora_eviction()
+        log.info("lora: evicted idle adapter %r from row %d", victim, row)
+        # The vacated device rows are NOT zeroed: nothing references a row
+        # without a refcount, and the next load overwrites it wholesale.
+        return victim
+
+    def _write_rows(self, info: AdapterInfo, row: int) -> None:
+        """Write one adapter's factors into pool row `row` of every target
+        leaf. Non-donating updates: in-flight dispatches flattened the old
+        arrays already, and no request can reference this row until acquire
+        returns."""
+        assert self.core is not None, "LoraManager.attach(core) first"
+        import jax.numpy as jnp
+
+        host = load_adapter_tensors(
+            info, self.cfg, pool_rank=self.rank_cap,
+            dtype=np.dtype(self.cfg.dtype),
+        )
+        params = self.core.params
+        for tgt in self.targets:
+            a_key, b_key = f"{tgt}{_LORA_A}", f"{tgt}{_LORA_B}"
+            pair = host.get(tgt)
+            if pair is None:
+                # target untouched by this adapter: zero the row (it may
+                # hold a previous tenant's factors)
+                a_upd = jnp.zeros(params[a_key].shape[2:],
+                                  params[a_key].dtype)
+                b_upd = jnp.zeros(params[b_key].shape[2:],
+                                  params[b_key].dtype)
+            else:
+                a_upd, b_upd = jnp.asarray(pair[0]), jnp.asarray(pair[1])
+            params[a_key] = params[a_key].at[:, row].set(a_upd)
+            params[b_key] = params[b_key].at[:, row].set(b_upd)
+
+    # ------------------------------------------------------------ introspection
+
+    def resident_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._resident)
+
+    def available_names(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, i in self.available.items()
+                          if i.error is None)
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "dir": self.lora_dir,
+                "max_adapters": self.max_adapters,
+                "rank_cap": self.rank_cap,
+                "targets": list(self.targets),
+                "available": self.available_names(),
+                "resident": sorted(self._resident),
+                "active": {n: c for n, c in sorted(self._refcounts.items())},
+                "loads_total": self.loads_total,
+                "evictions_total": self.evictions_total,
+            }

@@ -48,6 +48,19 @@ Params = dict[str, Any]
 # `<name>_scale` (f32 per output channel) pairs — llmlb_tpu/quant.
 _SCALE = "_scale"
 
+# Multi-LoRA adapter pools (llmlb_tpu/lora, docs/lora.md) ride the pytree as
+# `<name>_lora_a` [L, N, IN, R] / `<name>_lora_b` [L, N, R, OUT] pairs —
+# N stacked adapter slots over the base projection `<name>`, slot 0 all-zero
+# (the no-adapter identity row). Like the quant scales they are companions:
+# absent on LoRA-free engines, in which case every branch below compiles the
+# original program bit for bit.
+_LORA_A = "_lora_a"
+_LORA_B = "_lora_b"
+# Projections that can carry adapter deltas (attention always; the dense
+# SwiGLU MLP optionally — MoE expert FFNs are out of scope, so mixtral
+# engines serve attention-only adapters).
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -176,6 +189,14 @@ def param_logical_axes(cfg: LlamaConfig) -> dict[str, tuple]:
     # dropped — the scale is per OUTPUT channel.
     for name in ("wq", "wk", "wv", "wo", "wg", "wu", "wd"):
         axes[name + _SCALE] = (axes[name][0], axes[name][2])
+    # LoRA adapter pools (present only on LoRA-enabled engines): A keeps the
+    # weight's input axis (rank axis replicated — ranks are tiny), B keeps
+    # the output axis so the delta lands sharded exactly like the base
+    # projection's output under tp.
+    for name in LORA_TARGETS:
+        w_axes = axes[name]
+        axes[name + _LORA_A] = (w_axes[0], None, w_axes[1], None)
+        axes[name + _LORA_B] = (w_axes[0], None, None, w_axes[2])
     return axes
 
 
@@ -326,20 +347,33 @@ def _layer_stacked_names(cfg: LlamaConfig) -> list[str]:
 
 
 def _with_scales(params: Params, names: list[str]) -> list[str]:
-    """Extend a stacked-name list with the `<name>_scale` companions a
-    quantized pytree carries, so every per-layer slice sees its scales.
-    On an unquantized pytree this is the identity — same names, same jit
-    cache keys, bit-identical programs."""
-    return list(names) + [n + _SCALE for n in names if n + _SCALE in params]
+    """Extend a stacked-name list with the companions the pytree carries:
+    `<name>_scale` (int8 quant) and `<name>_lora_a`/`<name>_lora_b` (LoRA
+    adapter pools), so every per-layer slice sees them. On a plain pytree
+    this is the identity — same names, same jit cache keys, bit-identical
+    programs."""
+    out = list(names)
+    for n in names:
+        for suffix in (_SCALE, _LORA_A, _LORA_B):
+            if n + suffix in params:
+                out.append(n + suffix)
+    return out
 
 
-def _proj(lp: Params, name: str, x: jnp.ndarray) -> jnp.ndarray:
+def _proj(lp: Params, name: str, x: jnp.ndarray,
+          lora_idx: jnp.ndarray | None = None) -> jnp.ndarray:
     """`x @ W` with on-the-fly int8 dequant when W is quantized: the int8
     -> bf16 convert fuses into the einsum's operand read (HBM moves int8
     bytes), accumulation is fp32 (`preferred_element_type`), and the
     per-output-channel scale applies to the OUTPUT — exact, because the
     scale is constant along the contraction axis. Unquantized weights take
-    the original matmul untouched."""
+    the original matmul untouched.
+
+    With `lora_idx` ([B] int32 adapter pool rows) and this projection's
+    adapter pools in the layer slice, each row's rank-R LoRA delta is added
+    to the OUTPUT (ops/lora.py bgmv) — the int8 dequant path above is
+    untouched, and row 0 (the all-zero identity adapter) adds exactly 0.0,
+    keeping adapter-free rows bit-identical."""
     w = lp[name]
     scale = lp.get(name + _SCALE)
     if scale is None:
@@ -348,18 +382,26 @@ def _proj(lp: Params, name: str, x: jnp.ndarray) -> jnp.ndarray:
                 f"param {name!r} is int8 but its {name}{_SCALE} companion "
                 "is missing from the layer slice"
             )
-        return x @ w
-    y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
-                   preferred_element_type=jnp.float32)
-    return (y * scale).astype(x.dtype)
+        y = x @ w
+    else:
+        y32 = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+        y = (y32 * scale).astype(x.dtype)
+    if lora_idx is not None and name + _LORA_A in lp:
+        from llmlb_tpu.ops.lora import lora_delta
+
+        delta = lora_delta(x, lp[name + _LORA_A], lp[name + _LORA_B],
+                           lora_idx)
+        y = y + delta.astype(y.dtype)
+    return y
 
 
-def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray):
+def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray, lora_idx=None):
     b, t, _ = x.shape
     d = cfg.head_dim_
-    q = _proj(lp, "wq", x)
-    k = _proj(lp, "wk", x)
-    v = _proj(lp, "wv", x)
+    q = _proj(lp, "wq", x, lora_idx)
+    k = _proj(lp, "wk", x, lora_idx)
+    v = _proj(lp, "wv", x, lora_idx)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -371,25 +413,28 @@ def _qkv(cfg: LlamaConfig, lp: Params, x: jnp.ndarray):
     )
 
 
-def _mlp(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(lp: Params, x: jnp.ndarray, lora_idx=None) -> jnp.ndarray:
     return _proj(
-        lp, "wd", jax.nn.silu(_proj(lp, "wg", x)) * _proj(lp, "wu", x)
+        lp, "wd",
+        jax.nn.silu(_proj(lp, "wg", x, lora_idx))
+        * _proj(lp, "wu", x, lora_idx),
+        lora_idx,
     )
 
 
 def _attn_block(cfg: LlamaConfig, lp: Params, x: jnp.ndarray, positions,
-                inv_freq, attn_fn):
+                inv_freq, attn_fn, lora_idx=None):
     """Shared pre-norm attention sub-block (every serving path uses this one
     skeleton: norm → qkv → rope → attn_fn → wo residual). `attn_fn(q, k, v)`
     supplies the attention flavor (dense prefill / cache decode / ring) and may
     capture caches via closure. Returns (x_out, roped_k, roped_v)."""
     b, t, _ = x.shape
     h = rms_norm(x, lp["ln_attn"], cfg.rms_eps)
-    q, k, v = _qkv(cfg, lp, h)
+    q, k, v = _qkv(cfg, lp, h, lora_idx)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     attn = attn_fn(q, k, v)
-    return x + _proj(lp, "wo", attn.reshape(b, t, -1)), k, v
+    return x + _proj(lp, "wo", attn.reshape(b, t, -1), lora_idx), k, v
 
 
 def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
@@ -400,8 +445,9 @@ def _unembed(cfg: LlamaConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def _default_mlp_fn(lp: Params, h: jnp.ndarray, token_valid) -> jnp.ndarray:
-    return _mlp(lp, h)
+def _default_mlp_fn(lp: Params, h: jnp.ndarray, token_valid,
+                    lora_idx=None) -> jnp.ndarray:
+    return _mlp(lp, h, lora_idx)
 
 
 def _write_kv_fresh(cache, kv, positions):
@@ -423,13 +469,15 @@ def make_write_kv_slots(slot_ids: jnp.ndarray):
 
 
 def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_kv,
-                  *, stacked_names=None, mlp_fn=_default_mlp_fn):
+                  *, stacked_names=None, mlp_fn=_default_mlp_fn,
+                  lora_idx=None):
     """Shared prefill body for every model family.
 
     `write_kv(cache, new_kv, positions)` places K/V; `mlp_fn(lp, h,
-    token_valid)` is the per-family feed-forward (dense SwiGLU here, routed
-    experts for mixtral — token_valid marks non-padding tokens so MoE routing
-    can ignore padding)."""
+    token_valid, lora_idx)` is the per-family feed-forward (dense SwiGLU
+    here, routed experts for mixtral — token_valid marks non-padding tokens
+    so MoE routing can ignore padding). `lora_idx` ([B] int32, optional)
+    selects each row's adapter pool slot (docs/lora.md)."""
     b, t = input_ids.shape
     inv_freq = rope_frequencies(cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
@@ -444,11 +492,12 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
         carry_x, k, v = _attn_block(
             cfg, lp, carry_x, positions, inv_freq,
             lambda q, k, v: gqa_attention_prefill(q, k, v, prompt_lens),
+            lora_idx,
         )
         ck = write_kv(ck, k, positions)
         cv = write_kv(cv, v, positions)
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + mlp_fn(lp, h, token_valid)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid, lora_idx)
         return carry_x, (ck, cv)
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
@@ -460,7 +509,8 @@ def _prefill_impl(params, cfg, input_ids, prompt_lens, cache_k, cache_v, write_k
 
 
 def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
-                 *, stacked_names=None, mlp_fn=_default_mlp_fn, window=None):
+                 *, stacked_names=None, mlp_fn=_default_mlp_fn, window=None,
+                 lora_idx=None):
     """Shared one-token decode body for every model family.
 
     The layer loop is UNROLLED (static layer indices) rather than a
@@ -500,9 +550,10 @@ def _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
                 window=window,
             )
 
-        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn)
+        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn,
+                              lora_idx)
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
-        x = x + mlp_fn(lp, h, None)
+        x = x + mlp_fn(lp, h, None, lora_idx)
 
     logits = _unembed(cfg, params, x[:, 0])
     return logits, cache_k, cache_v
@@ -519,11 +570,13 @@ def prefill(
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused (GSPMD shards via param placement);
     # accepted so all model families share one serving-call signature
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Prefill B prompts into their KV slots. Returns (last_logits [B, V] fp32,
     cache_k, cache_v)."""
     return _prefill_impl(
-        params, cfg, input_ids, prompt_lens, cache_k, cache_v, _write_kv_fresh
+        params, cfg, input_ids, prompt_lens, cache_k, cache_v, _write_kv_fresh,
+        lora_idx=lora_idx,
     )
 
 
@@ -538,6 +591,7 @@ def prefill_into_slots(
     cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D] — the engine's live cache
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Prefill B prompts and scatter their KV into rows `slot_ids` of the live
     slot cache — the continuous-batching insert path (new requests land in freed
@@ -545,13 +599,14 @@ def prefill_into_slots(
     cache_k, cache_v)."""
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
-        make_write_kv_slots(slot_ids),
+        make_write_kv_slots(slot_ids), lora_idx=lora_idx,
     )
 
 
 def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
                          cache_k, cache_v, *, stacked_names=None,
-                         mlp_fn=_default_mlp_fn, all_logits=False, window=None):
+                         mlp_fn=_default_mlp_fn, all_logits=False, window=None,
+                         lora_idx=None):
     """Shared chunked-prefill body: process a [B, T] chunk of prompt tokens
     whose slots already hold `start_pos` tokens of KV. Queries attend over the
     full slot row (earlier chunks + causal within this chunk). Backs long
@@ -591,9 +646,10 @@ def _prefill_extend_impl(params, cfg, input_ids, chunk_lens, start_pos, slot_ids
                 q, k_rows, v_rows, positions, chunk_lens
             )
 
-        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
+        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq,
+                                    attn_fn, lora_idx)
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + mlp_fn(lp, h, token_valid)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid, lora_idx)
         return carry_x, (ck, cv)
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
@@ -620,6 +676,7 @@ def prefill_extend_slots(
     cache_k: jnp.ndarray,  # [L, NUM_SLOTS, CAP, K, D]
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Chunked prefill: append a chunk of prompt tokens to slots that already
     hold `start_pos` tokens, attending over everything so far. Lets the engine
@@ -628,7 +685,7 @@ def prefill_extend_slots(
     """
     return _prefill_extend_impl(
         params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
-        cache_k, cache_v,
+        cache_k, cache_v, lora_idx=lora_idx,
     )
 
 
@@ -643,6 +700,7 @@ def prefill_into_pages(
     cache_k: jnp.ndarray,  # [L, P, PS, K, D] — the engine's live page pool
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Prefill B prompts and scatter their KV through the block tables into
     the global page pool — the paged counterpart of prefill_into_slots.
@@ -659,13 +717,14 @@ def prefill_into_pages(
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v,
         make_write_kv_pages(block_tables, kv_pool_values(cache_k).shape[2]),
+        lora_idx=lora_idx,
     )
 
 
 def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
                                block_tables, cache_k, cache_v, *,
                                stacked_names=None, mlp_fn=_default_mlp_fn,
-                               all_logits=False, window=None):
+                               all_logits=False, window=None, lora_idx=None):
     """Paged counterpart of _prefill_extend_impl: the chunk's KV scatters
     through the block table into the page pool and attention reads the pool
     via ops.attention.paged_attention_extend. Padding tokens write garbage
@@ -710,9 +769,10 @@ def _prefill_extend_paged_impl(params, cfg, input_ids, chunk_lens, start_pos,
                 q, ck, cv, read_tables, positions, chunk_lens
             )
 
-        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq, attn_fn)
+        carry_x, _, _ = _attn_block(cfg, lp, carry_x, positions, inv_freq,
+                                    attn_fn, lora_idx)
         h = rms_norm(carry_x, lp["ln_mlp"], cfg.rms_eps)
-        carry_x = carry_x + mlp_fn(lp, h, token_valid)
+        carry_x = carry_x + mlp_fn(lp, h, token_valid, lora_idx)
         return carry_x, (ck, cv)
 
     x, (cache_k, cache_v) = lax.scan(layer, x, (stacked, cache_k, cache_v))
@@ -739,13 +799,14 @@ def prefill_extend_pages(
     cache_k: jnp.ndarray,  # [L, P, PS, K, D]
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Paged chunked prefill: append a chunk of prompt tokens to rows that
     already hold `start_pos` tokens, attending over everything so far
     through the block tables. Same contract as prefill_extend_slots."""
     return _prefill_extend_paged_impl(
         params, cfg, input_ids, chunk_lens, start_pos, block_tables,
-        cache_k, cache_v,
+        cache_k, cache_v, lora_idx=lora_idx,
     )
 
 
@@ -762,6 +823,7 @@ def verify_step(
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
     window: int | None = None,  # static context-window bucket
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Speculative verification over the dense slot cache: one extend-style
     dispatch scores the last committed token plus up to K draft tokens,
@@ -771,7 +833,7 @@ def verify_step(
     cells become garbage past the rolled-back length (standard contract)."""
     return _prefill_extend_impl(
         params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
-        cache_k, cache_v, all_logits=True, window=window,
+        cache_k, cache_v, all_logits=True, window=window, lora_idx=lora_idx,
     )
 
 
@@ -788,19 +850,20 @@ def verify_step_paged(
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
     window: int | None = None,  # static context-window bucket
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """Paged speculative verification: same contract as verify_step with the
     slot cache swapped for the page pool + block tables — the K+1-token
     ragged extend the paged attention kernels were built for."""
     return _prefill_extend_paged_impl(
         params, cfg, input_ids, chunk_lens, start_pos, block_tables,
-        cache_k, cache_v, all_logits=True, window=window,
+        cache_k, cache_v, all_logits=True, window=window, lora_idx=lora_idx,
     )
 
 
 def _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
                        block_tables, *, stacked_names=None,
-                       mlp_fn=_default_mlp_fn, window=None):
+                       mlp_fn=_default_mlp_fn, window=None, lora_idx=None):
     """Paged counterpart of _decode_impl (same unrolled layer loop — see
     that docstring for why decode never scans the cache). Each layer's
     one-token KV lands at page block_tables[b, pos//PS], offset pos%PS;
@@ -837,9 +900,10 @@ def _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
                 write_pos + 1, window=window,
             )
 
-        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn)
+        x, _, _ = _attn_block(cfg, lp, x, positions, inv_freq, attn_fn,
+                              lora_idx)
         h = rms_norm(x, lp["ln_mlp"], cfg.rms_eps)
-        x = x + mlp_fn(lp, h, None)
+        x = x + mlp_fn(lp, h, None, lora_idx)
 
     logits = _unembed(cfg, params, x[:, 0])
     return logits, cache_k, cache_v
@@ -857,12 +921,14 @@ def decode_step_paged(
     block_tables: jnp.ndarray,  # [B, PPN] int32
     mesh: Mesh | None = None,  # unused; shared family signature
     window: int | None = None,  # static context-window bucket (≥ max seq+1)
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """One paged decode step across all rows. Returns (logits [B, V] fp32,
     caches). Same contract as decode_step with the dense slot cache swapped
     for the page pool + block tables."""
     return _decode_paged_impl(params, cfg, input_ids, seq_lens, cache_k,
-                              cache_v, block_tables, window=window)
+                              cache_v, block_tables, window=window,
+                              lora_idx=lora_idx)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -979,7 +1045,8 @@ def decode_step(
     cache_v: jnp.ndarray,
     mesh: Mesh | None = None,  # unused; shared family signature
     window: int | None = None,  # static context-window bucket (≥ max seq+1)
+    lora_idx: jnp.ndarray | None = None,  # [B] int32 adapter pool rows
 ):
     """One decode step across all slots. Returns (logits [B, V] fp32, caches)."""
     return _decode_impl(params, cfg, input_ids, seq_lens, cache_k, cache_v,
-                        window=window)
+                        window=window, lora_idx=lora_idx)

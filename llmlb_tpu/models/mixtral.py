@@ -112,6 +112,13 @@ def param_logical_axes(cfg: MixtralConfig) -> dict[str, tuple]:
     for name in ("we_gate", "we_up", "we_down"):
         w_axes = axes[name]
         axes[name + "_scale"] = (w_axes[0], w_axes[1], w_axes[3])
+    # LoRA adapter pools (llmlb_tpu/lora): attention projections only — MoE
+    # engines serve attention-target adapters; expert-FFN deltas are out of
+    # scope (the routed dispatch would need per-expert per-adapter factors).
+    for name in ("wq", "wk", "wv", "wo"):
+        w_axes = axes[name]
+        axes[name + "_lora_a"] = (w_axes[0], None, w_axes[1], None)
+        axes[name + "_lora_b"] = (w_axes[0], None, None, w_axes[2])
     return axes
 
 
@@ -184,9 +191,12 @@ def _moe_mlp(cfg: MixtralConfig, lp: Params, x: jnp.ndarray, mesh: Mesh | None,
 
 
 def _moe_mlp_fn(cfg: MixtralConfig, mesh: Mesh | None, exact: bool):
-    """Adapter matching llama's `mlp_fn(lp, h, token_valid)` contract."""
+    """Adapter matching llama's `mlp_fn(lp, h, token_valid, lora_idx)`
+    contract. `lora_idx` is accepted and ignored: MoE engines serve
+    attention-target adapters only (the expert FFNs carry no LoRA pools,
+    so there is nothing for the index to select)."""
 
-    def fn(lp, h, token_valid):
+    def fn(lp, h, token_valid, lora_idx=None):
         return _moe_mlp(
             cfg, lp, h, mesh, exact=exact,
             token_valid=None if exact else token_valid,
@@ -198,20 +208,22 @@ def _moe_mlp_fn(cfg: MixtralConfig, mesh: Mesh | None, exact: bool):
 @partial(jax.jit, static_argnames=("cfg", "mesh"),
          donate_argnames=("cache_k", "cache_v"))
 def prefill(params, cfg: MixtralConfig, input_ids, prompt_lens, cache_k, cache_v,
-            mesh: Mesh | None = None):
+            mesh: Mesh | None = None, lora_idx=None):
     """Prefill B prompts into fresh KV slots. Same contract as llama.prefill."""
     b, t = input_ids.shape
     return _prefill_impl(
         params, cfg, input_ids, prompt_lens, cache_k, cache_v, _write_kv_fresh,
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+        lora_idx=lora_idx,
     )
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"),
          donate_argnames=("cache_k", "cache_v"))
 def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
-                       slot_ids, cache_k, cache_v, mesh: Mesh | None = None):
+                       slot_ids, cache_k, cache_v, mesh: Mesh | None = None,
+                       lora_idx=None):
     """Continuous-batching insert path. Same contract as llama.prefill_into_slots."""
     b, t = input_ids.shape
     return _prefill_impl(
@@ -219,6 +231,7 @@ def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
         make_write_kv_slots(slot_ids),
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+        lora_idx=lora_idx,
     )
 
 
@@ -226,7 +239,7 @@ def prefill_into_slots(params, cfg: MixtralConfig, input_ids, prompt_lens,
          donate_argnames=("cache_k", "cache_v"))
 def prefill_extend_slots(params, cfg: MixtralConfig, input_ids, chunk_lens,
                          start_pos, slot_ids, cache_k, cache_v,
-                         mesh: Mesh | None = None):
+                         mesh: Mesh | None = None, lora_idx=None):
     """Chunked-prefill append path. Same contract as llama.prefill_extend_slots."""
     b, t = input_ids.shape
     return _prefill_extend_impl(
@@ -234,13 +247,15 @@ def prefill_extend_slots(params, cfg: MixtralConfig, input_ids, chunk_lens,
         cache_k, cache_v,
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+        lora_idx=lora_idx,
     )
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "window"),
          donate_argnames=("cache_k", "cache_v"))
 def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_v,
-                mesh: Mesh | None = None, window: int | None = None):
+                mesh: Mesh | None = None, window: int | None = None,
+                lora_idx=None):
     """One decode step across all slots. Same contract as llama.decode_step.
 
     Decode is ALWAYS exact MoE: capacity drops here would make a request's
@@ -248,7 +263,7 @@ def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_
     return _decode_impl(
         params, cfg, input_ids, seq_lens, cache_k, cache_v,
         stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
-        window=window,
+        window=window, lora_idx=lora_idx,
     )
 
 
@@ -256,7 +271,7 @@ def decode_step(params, cfg: MixtralConfig, input_ids, seq_lens, cache_k, cache_
          donate_argnames=("cache_k", "cache_v"))
 def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
                        block_tables, cache_k, cache_v,
-                       mesh: Mesh | None = None):
+                       mesh: Mesh | None = None, lora_idx=None):
     """Paged insert path. Same contract as llama.prefill_into_pages —
     including its HANDOFF CONTRACT (docs/disaggregation.md): final-row
     logits aligned to batch rows and position-exact KV, so split-mode
@@ -269,6 +284,7 @@ def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
         make_write_kv_pages(block_tables, kv_pool_values(cache_k).shape[2]),
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+        lora_idx=lora_idx,
     )
 
 
@@ -276,7 +292,7 @@ def prefill_into_pages(params, cfg: MixtralConfig, input_ids, prompt_lens,
          donate_argnames=("cache_k", "cache_v"))
 def prefill_extend_pages(params, cfg: MixtralConfig, input_ids, chunk_lens,
                          start_pos, block_tables, cache_k, cache_v,
-                         mesh: Mesh | None = None):
+                         mesh: Mesh | None = None, lora_idx=None):
     """Paged chunked-prefill append. Same contract as llama.prefill_extend_pages."""
     b, t = input_ids.shape
     return _prefill_extend_paged_impl(
@@ -284,6 +300,7 @@ def prefill_extend_pages(params, cfg: MixtralConfig, input_ids, chunk_lens,
         cache_k, cache_v,
         stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=b * t <= 4 * cfg.num_experts),
+        lora_idx=lora_idx,
     )
 
 
@@ -291,7 +308,7 @@ def prefill_extend_pages(params, cfg: MixtralConfig, input_ids, chunk_lens,
          donate_argnames=("cache_k", "cache_v"))
 def verify_step(params, cfg: MixtralConfig, input_ids, chunk_lens, start_pos,
                 slot_ids, cache_k, cache_v, mesh: Mesh | None = None,
-                window: int | None = None):
+                window: int | None = None, lora_idx=None):
     """Speculative verification over the dense slot cache. Same contract as
     llama.verify_step; exact MoE like decode — capacity drops would make a
     draft's acceptance depend on which other slots share the batch."""
@@ -299,7 +316,7 @@ def verify_step(params, cfg: MixtralConfig, input_ids, chunk_lens, start_pos,
         params, cfg, input_ids, chunk_lens, start_pos, slot_ids,
         cache_k, cache_v, stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
-        all_logits=True, window=window,
+        all_logits=True, window=window, lora_idx=lora_idx,
     )
 
 
@@ -307,7 +324,8 @@ def verify_step(params, cfg: MixtralConfig, input_ids, chunk_lens, start_pos,
          donate_argnames=("cache_k", "cache_v"))
 def verify_step_paged(params, cfg: MixtralConfig, input_ids, chunk_lens,
                       start_pos, block_tables, cache_k, cache_v,
-                      mesh: Mesh | None = None, window: int | None = None):
+                      mesh: Mesh | None = None, window: int | None = None,
+                      lora_idx=None):
     """Paged speculative verification. Same contract as
     llama.verify_step_paged; exact MoE for the same batch-independence
     reason as decode_step."""
@@ -315,7 +333,7 @@ def verify_step_paged(params, cfg: MixtralConfig, input_ids, chunk_lens,
         params, cfg, input_ids, chunk_lens, start_pos, block_tables,
         cache_k, cache_v, stacked_names=_STACKED,
         mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
-        all_logits=True, window=window,
+        all_logits=True, window=window, lora_idx=lora_idx,
     )
 
 
@@ -323,11 +341,12 @@ def verify_step_paged(params, cfg: MixtralConfig, input_ids, chunk_lens,
          donate_argnames=("cache_k", "cache_v"))
 def decode_step_paged(params, cfg: MixtralConfig, input_ids, seq_lens,
                       cache_k, cache_v, block_tables,
-                      mesh: Mesh | None = None, window: int | None = None):
+                      mesh: Mesh | None = None, window: int | None = None,
+                      lora_idx=None):
     """One paged decode step. Same contract as llama.decode_step_paged;
     exact MoE for the same batch-independence reason as decode_step."""
     return _decode_paged_impl(
         params, cfg, input_ids, seq_lens, cache_k, cache_v, block_tables,
         stacked_names=_STACKED, mlp_fn=_moe_mlp_fn(cfg, mesh, exact=True),
-        window=window,
+        window=window, lora_idx=lora_idx,
     )

@@ -910,6 +910,197 @@ async def run_spec_bench(requests: int) -> dict:
     }
 
 
+async def run_lora_bench(requests: int) -> dict:
+    """Multi-LoRA workload (docs/lora.md): a mixed-adapter request stream
+    (3 adapters + adapter-free traffic) through the FULL gateway against a
+    real tpu:// engine (CPU backend), two ways:
+
+    - batched: all requests concurrent — the bgmv path decodes the mixed
+      batch together, every adapter stays resident (pool of 4);
+    - naive: the one-adapter-at-a-time swapping baseline — the engine's
+      pool holds ONE adapter and requests run strictly in arrival order,
+      so every adapter switch in the interleaved stream evicts and
+      reloads (what serving N tenants looks like on a server that must
+      swap the single active adapter instead of batching them).
+
+    Reports decode tok/s, wall-clock, per-request latency, adapter cache
+    hit rate (1 - loads/adapter_requests), and asserts the two modes'
+    greedy outputs are token-identical (batching must not change any
+    tenant's stream).
+
+    CPU-host honesty (the BENCH_r09 throughput stance): on a CPU backend
+    decode compute scales ~linearly with batch width, so batching buys no
+    wall-clock here and the committed transferable evidence is structural —
+    device dispatches per served token (batched runs ~6x fewer programs;
+    on TPU, where a wider decode step costs ~the same HBM sweep, that IS
+    the speedup) and the adapter cache hit rate (the naive server reloads
+    an adapter on nearly every switch)."""
+    import tempfile
+
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.presets import get_preset
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+    from llmlb_tpu.gateway.types import Capability, EndpointType
+    from llmlb_tpu.lora import save_adapter
+    from tests.support import GatewayHarness
+
+    adapters = ("acme", "globex", "initech")
+    lora_dir = tempfile.mkdtemp(prefix="bench-lora-")
+    cfg = get_preset("debug-tiny")
+    for name in adapters:
+        save_adapter(lora_dir, name, cfg, rank=8)
+
+    # request plan: round-robin across 3 adapters + adapter-free rows
+    plan = [(adapters[i % 4] if i % 4 < 3 else None)
+            for i in range(requests)]
+    gen_tokens = 24
+
+    async def run_mode(label: str, max_adapters: int,
+                       serialize: bool) -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-lora", num_slots=8,
+            slot_capacity=128, prefill_buckets=(16, 32), seed=0,
+            lora_dir=lora_dir, lora_max_adapters=max_adapters,
+        )
+        eng_server = TestServer(create_engine_app(engine,
+                                                  owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(
+                f"http://127.0.0.1:{eng_server.port}", [engine.model_id],
+                endpoint_type=EndpointType.TPU,
+                capabilities=[Capability.CHAT_COMPLETION, Capability.LORA],
+            )
+            headers = dict(await gw.inference_headers())
+
+            async def one(i: int, adapter: str | None) -> dict:
+                payload = {
+                    "model": engine.model_id,
+                    # ONE prompt for every tenant: output differences are
+                    # then purely the adapters' doing (distinctness check)
+                    "messages": [{"role": "user",
+                                  "content": "ticket escalation report"}],
+                    "max_tokens": gen_tokens, "temperature": 0.0,
+                }
+                if adapter is not None:
+                    payload["lora"] = adapter
+                t_req = time.perf_counter()
+                resp = await gw.client.post("/v1/chat/completions",
+                                            json=payload, headers=headers)
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                return {
+                    "adapter": adapter,
+                    "text": body["choices"][0]["message"]["content"],
+                    "tokens": body["usage"]["completion_tokens"],
+                    "e2e_s": time.perf_counter() - t_req,
+                }
+
+            core = engine.core
+            peak = 0
+            done = False
+
+            async def sample() -> None:
+                nonlocal peak
+                while not done:
+                    peak = max(peak, core.stats().active_slots)
+                    await asyncio.sleep(0.002)
+
+            sampler = asyncio.create_task(sample())
+            steps0 = core.metrics.decode_step.n
+            t0 = time.perf_counter()
+            if serialize:
+                # one adapter at a time, ARRIVAL order: every adapter
+                # switch in the interleaved stream swaps the pool's single
+                # slot (evict + disk->device reload) before decoding
+                outs = [await one(i, a) for i, a in enumerate(plan)]
+            else:
+                outs = list(await asyncio.gather(*(
+                    one(i, a) for i, a in enumerate(plan)
+                )))
+            elapsed = time.perf_counter() - t0
+            done = True
+            await sampler
+
+            adapter_requests = sum(1 for a in plan if a is not None)
+            loads = core.metrics.lora_loads_total
+            completion = sum(o["tokens"] for o in outs)
+            lat = sorted(o["e2e_s"] for o in outs)
+            return {
+                "request_latency_mean_s": round(
+                    sum(lat) / len(lat), 3
+                ),
+                "request_latency_p99_s": round(
+                    lat[min(len(lat) - 1, int(0.99 * len(lat)))], 3
+                ),
+                "label": label,
+                "requests": len(outs),
+                "seconds": round(elapsed, 2),
+                "decode_tokens_per_sec": round(completion / elapsed, 1),
+                "decode_dispatches": core.metrics.decode_step.n - steps0,
+                "peak_concurrent_sequences": peak,
+                "adapter_requests": adapter_requests,
+                "adapter_loads": loads,
+                "adapter_evictions": core.metrics.lora_evictions_total,
+                "adapter_cache_hit_rate": round(
+                    1.0 - loads / max(1, adapter_requests), 3
+                ),
+                "gateway_lora_requests":
+                    gw.state.metrics.summary()["lora_requests_total"],
+                "outputs": {o["adapter"] or "": o["text"] for o in outs},
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    batched = await run_mode("batched", max_adapters=4, serialize=False)
+    naive = await run_mode("naive-swap", max_adapters=1, serialize=True)
+
+    # tenant-stream integrity: batching must not change any output, and
+    # the adapters must actually produce distinct streams on one prompt
+    # (else everything above is vacuous)
+    identical = batched["outputs"] == naive["outputs"]
+    distinct = len(set(batched["outputs"].values())) == len(adapters) + 1
+    for mode in (batched, naive):
+        mode.pop("outputs")
+    return {
+        "metric": "lora_mixed_adapter_workload",
+        "requests": requests,
+        "adapters": len(adapters),
+        "outputs_token_identical_across_modes": identical,
+        "adapters_distinct": distinct,
+        "wall_clock_speedup": round(
+            naive["seconds"] / max(1e-9, batched["seconds"]), 2
+        ),
+        "decode_tps_ratio": round(
+            batched["decode_tokens_per_sec"]
+            / max(1e-9, naive["decode_tokens_per_sec"]), 2
+        ),
+        "batched": batched,
+        "naive": naive,
+        "dispatch_reduction": round(
+            naive["decode_dispatches"]
+            / max(1, batched["decode_dispatches"]), 2
+        ),
+        "cpu_host_caveat": (
+            "wall-clock unjudgeable on a CPU backend: decode compute "
+            "scales ~linearly with batch width, so batching cannot win "
+            "here; the transferable figures are dispatch_reduction and "
+            "adapter_cache_hit_rate (see docstring)"
+        ),
+        "passed": bool(
+            identical and distinct
+            and batched["adapter_cache_hit_rate"]
+            > naive["adapter_cache_hit_rate"]
+            and batched["decode_dispatches"] < naive["decode_dispatches"]
+        ),
+    }
+
+
 async def _make_named_key(gw, name: str) -> str:
     """A second inference API key so the slo-mix workload has distinct
     tenants (rate-limit overrides key by API-key name)."""
@@ -2508,7 +2699,7 @@ def main() -> None:
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
                  "structured", "spec-decode", "quantized", "throughput",
-                 "slo-mix", "disagg"),
+                 "slo-mix", "disagg", "lora"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
@@ -2566,6 +2757,12 @@ def main() -> None:
         return
     elif args.workload == "disagg":
         result = asyncio.run(run_disagg_bench(args.requests))
+        print(json.dumps(result))
+        if not result["passed"]:
+            sys.exit(1)
+        return
+    elif args.workload == "lora":
+        result = asyncio.run(run_lora_bench(args.requests))
         print(json.dumps(result))
         if not result["passed"]:
             sys.exit(1)

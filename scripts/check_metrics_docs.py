@@ -47,6 +47,9 @@ def engine_metric_names() -> set[str]:
     from llmlb_tpu.engine.metrics import EngineMetrics
 
     m = EngineMetrics()
+    # one sample per labeled lora family so the conditional series render
+    m.record_lora_request("sample")
+    m.record_lora_load(0.0)
     text = m.render(
         queue_depth=0, active_slots=0, num_slots=1,
         prefix_cache={
@@ -70,6 +73,8 @@ def engine_metric_names() -> set[str]:
         quant={"mode": "all", "param_bytes": 0},
         sched={"queued_by_class": {"high": 0, "normal": 0, "low": 0},
                "queued_by_role": {"prefill": 0, "decode": 0}},
+        lora={"enabled": True, "resident": ["sample"],
+              "available": ["sample"], "max_adapters": 8},
     )
     return set(_TYPE_RE.findall(text))
 

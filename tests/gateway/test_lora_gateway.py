@@ -1,0 +1,261 @@
+"""Gateway-side multi-LoRA routing (llmlb_tpu/lora/gateway.py, docs/lora.md):
+hot/load/refuse resolution, both-dialect 400 parity for the `lora` field,
+adapter-aware prefix-affinity hashing, and the per-probe hot-adapter sync."""
+
+import asyncio
+
+import pytest
+
+from llmlb_tpu.gateway.balancer import prefix_affinity_hash
+from llmlb_tpu.gateway.types import Capability, EndpointType
+from llmlb_tpu.lora.gateway import forward_model_name, lora_route_for
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+CHAT = "/v1/chat/completions"
+MESSAGES = "/v1/messages"
+LONG_SYS = "You are a helpful assistant. " * 20  # clears the min-chars gate
+
+
+# -------------------------------------------------------- affinity hashing
+
+
+def test_affinity_hash_separates_adapters():
+    """Regression (satellite): two adapters sharing a prompt must never
+    share an affinity pin — under LoRA the warm KV they would steer to is
+    adapter-specific."""
+    h_base = prefix_affinity_hash("m", LONG_SYS)
+    h_a = prefix_affinity_hash("m", LONG_SYS, lora="acme")
+    h_b = prefix_affinity_hash("m", LONG_SYS, lora="globex")
+    assert h_base and h_a and h_b
+    assert len({h_base, h_a, h_b}) == 3
+    # stability: the adapter-free key is unchanged vs the pre-LoRA hash
+    assert h_base == prefix_affinity_hash("m", LONG_SYS, lora=None)
+    assert h_a == prefix_affinity_hash("m", LONG_SYS, lora="acme")
+
+
+# ---------------------------------------------------------- route resolution
+
+
+def _register(gw, url, models, caps, name):
+    return gw.register_mock(url, models, endpoint_type=EndpointType.TPU,
+                            capabilities=caps, name=name)
+
+
+def test_route_resolution_hot_load_refuse():
+    async def run():
+        gw = await GatewayHarness.create()
+        try:
+            lora_caps = [Capability.CHAT_COMPLETION, Capability.LORA]
+            # hot: ep-a advertises the resident adapter as a model entry
+            _register(gw, "http://h1", ["m", "m:acme"], lora_caps, "ep-a")
+            # load-only: ep-b serves m with an adapter store, nothing hot
+            _register(gw, "http://h2", ["m"], lora_caps, "ep-b")
+            # capability-free endpoint: never a lora candidate
+            _register(gw, "http://h3", ["m"],
+                      [Capability.CHAT_COMPLETION], "ep-c")
+
+            hot = lora_route_for(gw.state, {"model": "m:acme"})
+            assert hot is not None and hot.kind == "hot"
+            assert hot.canonical == "m:acme" and hot.adapter == "acme"
+
+            load = lora_route_for(gw.state, {"model": "m", "lora": "cold"})
+            assert load is not None and load.kind == "load"
+            assert load.canonical == "m"
+            assert load.capability is Capability.LORA
+
+            # no lora-capable endpoint for the model at all → refuse,
+            # naming the field
+            with pytest.raises(ValueError, match="'lora'"):
+                lora_route_for(gw.state, {"model": "other", "lora": "x"})
+
+            # a literal colon-model that IS served routes normally
+            _register(gw, "http://h4", ["llama3:8b"],
+                      [Capability.CHAT_COMPLETION], "ep-d")
+            assert lora_route_for(gw.state, {"model": "llama3:8b"}) is None
+
+            # adapter-free request: no route object
+            assert lora_route_for(gw.state, {"model": "m"}) is None
+        finally:
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_forward_model_name():
+    class R:
+        adapter = "acme"
+        kind = "hot"
+    assert forward_model_name(R(), "eng-m:acme", "m") == "eng-m:acme"
+    R.kind = "load"
+    assert forward_model_name(R(), "eng-m", "m") == "eng-m:acme"
+    assert forward_model_name(R(), None, "m") == "m:acme"
+    assert forward_model_name(R(), "eng-m:acme", "m") == "eng-m:acme"
+
+
+# ------------------------------------------------- both-dialect 400 parity
+
+
+def test_lora_field_400_parity_both_dialects():
+    """Malformed `lora` values and fleet-unserveable adapters 400 on BOTH
+    dialects with the field named — the engine-server/gateway parity the
+    speculative/response_format validators established."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = None
+        try:
+            mock = await MockOpenAIEndpoint(model="m").start()
+            _register(gw, mock.url, ["m"],
+                      [Capability.CHAT_COMPLETION, Capability.LORA], "ep")
+            headers = await gw.inference_headers()
+            msgs = [{"role": "user", "content": "hi"}]
+
+            for bad, needle in (
+                (7, "'lora'"),
+                ("bad name", "'lora'"),
+            ):
+                r = await gw.client.post(CHAT, json={
+                    "model": "m", "messages": msgs, "lora": bad,
+                }, headers=headers)
+                assert r.status == 400, await r.text()
+                assert needle in (await r.json())["error"]["message"]
+
+                r = await gw.client.post(MESSAGES, json={
+                    "model": "m", "max_tokens": 8, "messages": msgs,
+                    "lora": bad,
+                }, headers=headers)
+                assert r.status == 400, await r.text()
+                body = await r.json()
+                assert body["type"] == "error"
+                assert needle in body["error"]["message"]
+
+            # adapter for a model with no lora-capable endpoint: 400 naming
+            # the field (before 404ing), both dialects
+            r = await gw.client.post(CHAT, json={
+                "model": "elsewhere", "messages": msgs, "lora": "acme",
+            }, headers=headers)
+            assert r.status == 400
+            assert "'lora'" in (await r.json())["error"]["message"]
+            r = await gw.client.post(MESSAGES, json={
+                "model": "elsewhere", "max_tokens": 8, "messages": msgs,
+                "lora": "acme",
+            }, headers=headers)
+            assert r.status == 400
+            assert "'lora'" in (await r.json())["error"]["message"]
+
+            summary = gw.state.metrics.summary()
+            assert summary["lora_requests_total"] >= 6
+        finally:
+            if mock is not None:
+                await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+# ------------------------------------------------------- end-to-end forward
+
+
+def test_adapter_forwarded_to_engine_both_dialects():
+    """The selected engine sees the adapter on the model name AND the
+    explicit field (cold-load route), on both dialects; the gateway's
+    route counter records the load."""
+    async def run():
+        gw = await GatewayHarness.create()
+        mock = None
+        try:
+            mock = await MockOpenAIEndpoint(model="m").start()
+            _register(gw, mock.url, ["m"],
+                      [Capability.CHAT_COMPLETION, Capability.LORA], "ep")
+            headers = await gw.inference_headers()
+            msgs = [{"role": "user", "content": "hi"}]
+
+            r = await gw.client.post(CHAT, json={
+                "model": "m:acme", "messages": msgs, "max_tokens": 4,
+            }, headers=headers)
+            assert r.status == 200, await r.text()
+            seen = mock.requests_seen[-1]
+            assert seen["model"] == "m:acme" and seen["lora"] == "acme"
+
+            r = await gw.client.post(MESSAGES, json={
+                "model": "m", "lora": "acme", "max_tokens": 4,
+                "messages": msgs,
+            }, headers=headers)
+            assert r.status == 200, await r.text()
+            seen = mock.requests_seen[-1]
+            assert seen["model"] == "m:acme" and seen["lora"] == "acme"
+
+            text = gw.state.metrics.render()
+            assert 'llmlb_gateway_lora_requests_total{route="load"} 2' \
+                in text
+        finally:
+            if mock is not None:
+                await mock.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+# --------------------------------------------- per-probe hot-adapter sync
+
+
+def test_health_probe_mirrors_resident_adapters_into_models():
+    """The health checker turns a probe's lora.resident advertisement into
+    `base:adapter` model entries (and removes them when they evict), so
+    hot-routing reacts within one probe interval — the disagg-role
+    re-parse precedent."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    resident = ["acme"]
+
+    async def health(request):
+        return web.json_response({
+            "status": "ok",
+            "tpu": {"accelerator": "tpu", "chip_count": 1},
+            "engine": {"num_slots": 4, "active_slots": 0, "queued": 0},
+            "lora": {"enabled": True, "resident": list(resident),
+                     "available": ["acme", "coldone"]},
+        })
+
+    async def run():
+        gw = await GatewayHarness.create()
+        server = None
+        try:
+            app = web.Application()
+            app.router.add_get("/api/health", health)
+            server = TestServer(app)
+            await server.start_server()
+            url = f"http://127.0.0.1:{server.port}"
+            ep = _register(gw, url, ["m"],
+                           [Capability.CHAT_COMPLETION, Capability.LORA],
+                           "ep")
+
+            from llmlb_tpu.gateway.health import EndpointHealthChecker
+
+            checker = EndpointHealthChecker(
+                gw.state.registry, gw.state.load_manager, gw.state.db,
+                session=gw.state.http,
+            )
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            ids = {m.model_id for m in gw.state.registry.models_for(ep.id)}
+            assert ids == {"m", "m:acme"}
+            route = lora_route_for(gw.state, {"model": "m:acme"})
+            assert route is not None and route.kind == "hot"
+
+            # eviction: the adapter leaves the advertisement → entry drops
+            resident.clear()
+            await checker.check_endpoint(gw.state.registry.get(ep.id))
+            ids = {m.model_id for m in gw.state.registry.models_for(ep.id)}
+            assert ids == {"m"}
+            route = lora_route_for(gw.state, {"model": "m:acme"})
+            assert route is not None and route.kind == "load"
+            # a non-resident but STORE-AVAILABLE adapter cold-loads...
+            route = lora_route_for(gw.state, {"model": "m:coldone"})
+            assert route is not None and route.kind == "load"
+            # ...but a name in NO advertised store refuses with a clean
+            # 400 naming the field, instead of proxying to a certain
+            # engine-side 400
+            with pytest.raises(ValueError, match="'lora'"):
+                lora_route_for(gw.state, {"model": "m", "lora": "ghost"})
+        finally:
+            if server is not None:
+                await server.close()
+            await gw.close()
+    asyncio.run(run())

@@ -381,3 +381,57 @@ def test_engine_abort_distinct_from_stream_cut():
                 await a.stop()
             await gw.close()
     asyncio.run(run())
+
+
+def test_midstream_resume_with_active_adapter_token_identical():
+    """Satellite (docs/lora.md): a stream with a LoRA adapter attached cuts
+    mid-generation and resumes token-identically — the resume POST carries
+    the SAME `lora` field (it rides the original chat body), so the adopting
+    engine replays prompt+committed through the same adapter deltas."""
+    from llmlb_tpu.gateway.types import Capability
+
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a = await MockResumableEndpoint(model="m", script=SCRIPT).start()
+            b = await MockResumableEndpoint(model="m", script=SCRIPT).start()
+            caps = [Capability.CHAT_COMPLETION, Capability.LORA]
+            for mock, name in ((a, "eng-a"), (b, "eng-b")):
+                gw.register_mock(mock.url, ["m"],
+                                 endpoint_type=EndpointType.TPU,
+                                 capabilities=caps, name=name)
+            _set_resilience(gw, breaker_failure_threshold=3)
+            gw.state.faults = FaultInjector()
+            gw.state.faults.add_rule(FaultRule(
+                kind="engine_abort", endpoint="*", path="chat",
+                after_bytes=900, max_fires=1,
+            ))
+            headers = await gw.inference_headers()
+            body = {**_chat_body(), "lora": "acme"}
+            r = await gw.client.post(CHAT, json=body, headers=headers)
+            assert r.status == 200, await r.text()
+            raw = await r.read()
+            assert b"event: error" not in raw
+            assert_sse_protocol(raw, "openai")
+            assert _openai_stream_text(raw) == FULL_TEXT
+            # the first engine saw the adapter (cold-load route: model
+            # suffix + explicit field, agreeing)
+            first = (a.requests_seen + b.requests_seen)[0]
+            assert first["lora"] == "acme"
+            assert first["model"] == "m:acme"
+            # exactly one resume, and its body still names the adapter
+            resumes = a.resume_calls + b.resume_calls
+            assert len(resumes) == 1
+            assert resumes[0]["lora"] == "acme"
+            committed = resumes[0]["committed_ids"]
+            assert committed == SCRIPT[:len(committed)] and committed
+            assert gw.state.metrics.summary()["stream_resumes"] == {
+                "success": 1
+            }
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())

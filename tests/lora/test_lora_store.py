@@ -1,0 +1,210 @@
+"""LoRA adapter store + manager + request-surface units (docs/lora.md)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from llmlb_tpu.engine.presets import get_preset
+from llmlb_tpu.lora import (
+    adapter_from_body,
+    discover_adapters,
+    load_adapter_tensors,
+    lora_target_dims,
+    save_adapter,
+    split_model_adapter,
+)
+from llmlb_tpu.lora.manager import LoraManager
+
+CFG = get_preset("debug-tiny")
+ALL_TARGETS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+# --------------------------------------------------------------------- store
+
+
+def test_save_discover_roundtrip(tmp_path):
+    save_adapter(str(tmp_path), "acme", CFG, rank=4, alpha=8.0,
+                 targets=("wq", "wv"))
+    found = discover_adapters(str(tmp_path), rank_cap=16,
+                              allowed_targets=ALL_TARGETS)
+    assert set(found) == {"acme"}
+    info = found["acme"]
+    assert info.error is None
+    assert info.rank == 4 and info.alpha == 8.0
+    assert info.targets == ("wq", "wv")
+
+
+def test_rank_over_cap_is_recorded_not_raised(tmp_path):
+    save_adapter(str(tmp_path), "fat", CFG, rank=32)
+    found = discover_adapters(str(tmp_path), rank_cap=16,
+                              allowed_targets=ALL_TARGETS)
+    assert found["fat"].error is not None
+    assert "rank 32" in found["fat"].error
+
+
+def test_unsupported_target_module_is_recorded(tmp_path):
+    path = save_adapter(str(tmp_path), "weird", CFG, rank=2)
+    cfgp = os.path.join(path, "adapter_config.json")
+    with open(cfgp) as f:
+        cfg = json.load(f)
+    cfg["target_modules"] = ["embed_tokens"]
+    with open(cfgp, "w") as f:
+        json.dump(cfg, f)
+    found = discover_adapters(str(tmp_path), rank_cap=16,
+                              allowed_targets=ALL_TARGETS)
+    assert "embed_tokens" in (found["weird"].error or "")
+
+
+def test_load_tensors_shapes_rank_pad_and_alpha_fold(tmp_path):
+    save_adapter(str(tmp_path), "acme", CFG, rank=4, alpha=8.0,
+                 targets=("wq",), scale=1.0)
+    found = discover_adapters(str(tmp_path), rank_cap=16,
+                              allowed_targets=ALL_TARGETS)
+    host = load_adapter_tensors(found["acme"], CFG, pool_rank=16,
+                                dtype=np.float32)
+    assert set(host) == {"wq"}
+    a, b = host["wq"]
+    in_dim, out_dim = lora_target_dims(CFG, ("wq",))["wq"]
+    assert a.shape == (CFG.num_layers, in_dim, 16)
+    assert b.shape == (CFG.num_layers, 16, out_dim)
+    # rank pads with exact zeros beyond r=4
+    assert np.all(a[:, :, 4:] == 0) and np.all(b[:, 4:, :] == 0)
+    assert np.any(a[:, :, :4] != 0)
+    # alpha/r = 2.0 folded into B: reload with alpha=r and compare
+    save_adapter(str(tmp_path), "acme2", CFG, rank=4, alpha=4.0,
+                 targets=("wq",), scale=1.0)
+    found2 = discover_adapters(str(tmp_path), rank_cap=16,
+                               allowed_targets=ALL_TARGETS)
+    host2 = load_adapter_tensors(found2["acme2"], CFG, pool_rank=16,
+                                 dtype=np.float32)
+    # same name-derived RNG seed is per-name, so compare magnitudes via
+    # the fold factor on one adapter instead: B scales linearly in alpha
+    save_adapter(str(tmp_path), "acme", CFG, rank=4, alpha=16.0,
+                 targets=("wq",), scale=1.0)
+    found3 = discover_adapters(str(tmp_path), rank_cap=16,
+                               allowed_targets=ALL_TARGETS)
+    host3 = load_adapter_tensors(found3["acme"], CFG, pool_rank=16,
+                                 dtype=np.float32)
+    np.testing.assert_allclose(host3["wq"][1], 2.0 * b, rtol=1e-6)
+    del host2
+
+
+# ----------------------------------------------------------------- request api
+
+
+def test_split_model_adapter():
+    assert split_model_adapter("m:acme") == ("m", "acme")
+    assert split_model_adapter("m") == ("m", None)
+    assert split_model_adapter(None) == (None, None)
+    # empty base or non-name suffix stays a literal model string
+    assert split_model_adapter(":acme") == (":acme", None)
+    assert split_model_adapter("m:!bad!") == ("m:!bad!", None)
+
+
+def test_adapter_from_body_field_and_suffix():
+    assert adapter_from_body({"model": "m", "lora": "a"}) == ("m", "a")
+    assert adapter_from_body({"model": "m:a"}) == ("m", "a")
+    assert adapter_from_body({"model": "m:a", "lora": "a"}) == ("m", "a")
+    assert adapter_from_body({"model": "m"}) == ("m", None)
+
+
+@pytest.mark.parametrize("body,needle", [
+    ({"model": "m", "lora": 7}, "'lora'"),
+    ({"model": "m", "lora": ""}, "'lora'"),
+    ({"model": "m", "lora": "bad name"}, "'lora'"),
+    ({"model": "m:a", "lora": "b"}, "conflicts"),
+])
+def test_adapter_from_body_rejects_naming_field(body, needle):
+    with pytest.raises(ValueError, match=needle):
+        adapter_from_body(body)
+
+
+# --------------------------------------------------------------------- manager
+
+
+class _FakeCore:
+    """Just enough of EngineCore for the manager's device writes."""
+
+    def __init__(self, mgr):
+        import jax.numpy as jnp
+
+        self.params = {
+            k: jnp.asarray(v) for k, v in mgr.init_pool_leaves(
+                np.float32
+            ).items()
+        }
+
+
+def _manager(tmp_path, names=("a1", "a2", "a3"), max_adapters=2,
+             rank=2):
+    for n in names:
+        save_adapter(str(tmp_path), n, CFG, rank=rank, targets=("wq",))
+    mgr = LoraManager(CFG, lora_dir=str(tmp_path),
+                      max_adapters=max_adapters, rank_cap=8,
+                      targets=ALL_TARGETS)
+    mgr.attach(_FakeCore(mgr))
+    return mgr
+
+
+def test_manager_acquire_loads_and_is_idempotent(tmp_path):
+    mgr = _manager(tmp_path)
+    row = mgr.acquire("a1", "req1")
+    assert row == 1
+    assert mgr.acquire("a1", "req1") == row  # idempotent per token
+    assert mgr.loads_total == 1
+    assert mgr.slot_of("a1") == row
+    assert mgr.slot_of(None) == 0
+    # device rows actually written
+    import jax.numpy as jnp
+
+    assert float(jnp.abs(mgr.core.params["wq_lora_a"][:, row]).sum()) > 0
+
+
+def test_manager_lru_evicts_only_idle(tmp_path):
+    mgr = _manager(tmp_path, max_adapters=2)
+    mgr.acquire("a1", "r1")
+    mgr.acquire("a2", "r2")
+    # pool full, both active: third adapter must be refused
+    with pytest.raises(ValueError, match="pool exhausted"):
+        mgr.acquire("a3", "r3")
+    mgr.release("r1")  # a1 idle now
+    row = mgr.acquire("a3", "r3")
+    assert mgr.evictions_total == 1
+    assert "a1" not in mgr.resident_names()
+    assert {"a2", "a3"} <= set(mgr.resident_names())
+    assert row == mgr.slot_of("a3")
+
+
+def test_manager_release_is_idempotent(tmp_path):
+    mgr = _manager(tmp_path)
+    mgr.acquire("a1", "r1")
+    mgr.release("r1")
+    mgr.release("r1")  # second release must not underflow another holder
+    mgr.acquire("a1", "r2")
+    mgr.release("r1")  # stale token again: still a no-op
+    # r2 still holds a refcount: the forced eviction below (pool of 2,
+    # third adapter arrives) must evict idle a2, never active a1
+    mgr.acquire("a2", "x1")
+    mgr.release("x1")
+    mgr.acquire("a3", "x2")
+    assert "a1" in mgr.resident_names()
+    assert "a2" not in mgr.resident_names()
+
+
+def test_manager_unknown_and_invalid_name_400_shape(tmp_path):
+    mgr = _manager(tmp_path)
+    with pytest.raises(ValueError, match="'lora' names unknown adapter"):
+        mgr.validate("nope")
+    save_adapter(str(tmp_path), "fat", CFG, rank=64)
+    with pytest.raises(ValueError, match="rank 64"):
+        mgr.validate("fat")  # rescan picks it up, error names the cause
+
+
+def test_manager_rescan_discovers_new_adapters(tmp_path):
+    mgr = _manager(tmp_path, names=("a1",))
+    assert mgr.available_names() == ["a1"]
+    save_adapter(str(tmp_path), "late", CFG, rank=2, targets=("wq",))
+    # validate() rescans on a miss, so the new adapter is acquirable
+    assert mgr.acquire("late", "r") >= 1
