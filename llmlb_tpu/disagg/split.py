@@ -180,6 +180,7 @@ class SplitRuntime:
             slot.handoff_ready_at = time.monotonic()
             core._seq_lens[slot_id] = 0
             rows.append(slot_id)
+            core._fr_emit(request, "staged", tokens=n, slot=slot_id)
         import jax.numpy as jnp
 
         core._d_seq_lens = core._d_seq_lens.at[
@@ -269,6 +270,8 @@ class SplitRuntime:
         finally:
             core._tls.tag = prev
         core.metrics.record_handoff("in_process", latency)
+        core._fr_emit(request, "adopted", in_process=True,
+                      staged_s=round(latency, 6))
 
     def pump_handoffs(self) -> bool:
         """Adopt staged requests into decode slots, most important class

@@ -406,7 +406,8 @@ class EngineMetrics:
                perf: dict | None = None,
                quant: dict | None = None,
                sched: dict | None = None,
-               lora: dict | None = None) -> str:
+               lora: dict | None = None,
+               flightrec: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
@@ -415,7 +416,10 @@ class EngineMetrics:
         block (mask-cache size gauges); `perf` is its perf_info() block —
         MFU / HBM-bandwidth gauges render when the chip is in the peak-spec
         table and decode traffic has flowed; `quant` is its quant_info()
-        block (active int8 mode + honest byte footprints)."""
+        block (active int8 mode + honest byte footprints); `flightrec` is
+        the flight recorder's counters() block (docs/tracing.md) — the
+        queue/service seconds pair feeds the Grafana queue-vs-compute
+        panel."""
         with self._lock:
             lines = [
                 "# TYPE llmlb_engine_requests_total counter",
@@ -562,6 +566,27 @@ class EngineMetrics:
                 hname = "llmlb_engine_lora_load_seconds"
                 lines.append(f"# TYPE {hname} histogram")
                 _render_histogram(lines, hname, self.lora_load)
+            if flightrec is not None and flightrec.get("enabled"):
+                lines += [
+                    "# TYPE llmlb_engine_flightrec_events_total counter",
+                    "llmlb_engine_flightrec_events_total "
+                    f"{flightrec.get('events_total', 0)}",
+                    "# TYPE llmlb_engine_flightrec_events_dropped_total "
+                    "counter",
+                    "llmlb_engine_flightrec_events_dropped_total "
+                    f"{flightrec.get('events_dropped_total', 0)}",
+                    "# TYPE llmlb_engine_flightrec_requests_tracked gauge",
+                    "llmlb_engine_flightrec_requests_tracked "
+                    f"{flightrec.get('requests_tracked', 0)}",
+                    "# TYPE llmlb_engine_flightrec_queue_seconds_total "
+                    "counter",
+                    "llmlb_engine_flightrec_queue_seconds_total "
+                    f"{flightrec.get('queue_seconds_total', 0.0)}",
+                    "# TYPE llmlb_engine_flightrec_service_seconds_total "
+                    "counter",
+                    "llmlb_engine_flightrec_service_seconds_total "
+                    f"{flightrec.get('service_seconds_total', 0.0)}",
+                ]
             if perf is not None and perf.get("available"):
                 lines += [
                     "# TYPE llmlb_engine_mfu_ratio gauge",

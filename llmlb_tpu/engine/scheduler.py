@@ -51,6 +51,7 @@ import numpy as np
 from llmlb_tpu.engine.metrics import EngineMetrics
 from llmlb_tpu.engine.paging import PagePool
 from llmlb_tpu.engine.prefix_cache import PrefixCache, PrefixEntry
+from llmlb_tpu.engine.flightrec import FlightRecorder, gateway_rid
 from llmlb_tpu.engine.stepstats import StepRecorder
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
@@ -858,6 +859,12 @@ class EngineCore:
         # reads. Always on — the recorder is a few clock reads per step
         # (< 1% of step time, guarded by test_step_introspection).
         self.step_stats = StepRecorder()
+        # Per-request flight recorder (engine/flightrec.py): one event per
+        # lifecycle edge, keyed by the gateway's X-Request-Id, served at
+        # /api/requests/{id}/timeline and joined cross-process by the
+        # gateway's /api/traces/{id}?view=timeline. LLMLB_FLIGHTREC=0
+        # disables it (emit() returns before its first clock read).
+        self.flightrec = FlightRecorder()
         # plan/insert time accrued since the last dispatched step; the next
         # step record absorbs it (admission happens between dispatches)
         self._pending_plan_s = 0.0
@@ -1038,6 +1045,8 @@ class EngineCore:
         self.prepare_lora(request)
         with self._lock:
             self.total_requests += 1
+        self._fr_emit(request, "admitted", prompt_tokens=n,
+                      queue_depth=self.pending.qsize())
         if self.coordinator is not None:
             # multihost: requests enter via the tick plan so every host
             # mirrors the same queue in the same order
@@ -1059,7 +1068,12 @@ class EngineCore:
                 "'lora' adapters are not enabled on this engine "
                 "(start it with --lora-dir)"
             )
+        t0 = time.perf_counter()
         self.lora.acquire(name, request.request_id)
+        # fires once per acquire call; the submit-time re-acquire of a
+        # service-prepared adapter shows as a second event with ~0 wait
+        self._fr_emit(request, "lora_acquire", adapter=name,
+                      wait_s=round(time.perf_counter() - t0, 6))
 
     def _release_lora(self, request: Request) -> None:
         """Unpin a request's adapter at its terminal event (idempotent —
@@ -1067,6 +1081,13 @@ class EngineCore:
         record_request_done pairs with one of these."""
         if self.lora is not None and request.sampling.lora:
             self.lora.release(request.request_id)
+
+    def _fr_emit(self, request: Request, event: str, **attrs) -> None:
+        """One flight-recorder event for a request. Every terminal path
+        (finish / error / shed / park) must call this next to its event-queue
+        put — statically enforced by scripts/check_lifecycle_events.py."""
+        if self.flightrec.enabled:
+            self.flightrec.emit(request.request_id, event, **attrs)
 
     def _lora_rows(self, requests) -> "np.ndarray":
         """Adapter pool rows for an ordered request list — the per-row
@@ -1140,6 +1161,7 @@ class EngineCore:
             if req.cancelled:
                 req.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
+                self._fr_emit(req, "finished", reason="cancelled")
                 self._release_lora(req)
                 continue
             if req.deadline_expired():
@@ -1149,12 +1171,15 @@ class EngineCore:
                 req.events.put(("error", "deadline exceeded before prefill"))
                 self.metrics.record_request_done("error")
                 self.metrics.record_deadline_shed()
+                self._fr_emit(req, "shed", reason="deadline")
                 self._release_lora(req)
                 continue
             n = len(req.prompt_ids)
             if n > budget:
                 req.events.put(("error", "prompt too large for a tick plan"))
                 self.metrics.record_request_done("error")
+                self._fr_emit(req, "errored",
+                              message="prompt too large for a tick plan")
                 self._release_lora(req)
                 continue
             if tokens + n > budget:
@@ -1270,6 +1295,7 @@ class EngineCore:
         for request in flushed:
             request.events.put(("error", "engine draining"))
             self.metrics.record_request_done("error")
+            self._fr_emit(request, "errored", message="engine draining")
             self._release_lora(request)
         if flushed:
             log.info("drain flushed %d queued request(s)", len(flushed))
@@ -1282,7 +1308,7 @@ class EngineCore:
         for i, slot in enumerate(self.slots):
             if (slot.request is not None and not slot.prefilling
                     and not slot.first_pending and not slot.handoff_ready):
-                self._park_slot(i)
+                self._park_slot(i, reason="drain")
                 self.metrics.record_drain_park()
 
     def _loop(self) -> None:
@@ -1343,17 +1369,36 @@ class EngineCore:
         self._prefix_pinned_pages = 0
 
     def _record_step(self, kind: str, phases: dict[str, float], *,
-                     active_slots: int = 0, tokens: int = 0) -> None:
+                     active_slots: int = 0, tokens: int = 0,
+                     slots: "list[int] | None" = None) -> None:
         """Finalize one step record: absorb plan/insert time accrued since
         the previous dispatch, feed the ring buffer + anomaly detector, and
-        mirror the phase durations into the Prometheus histograms."""
+        mirror the phase durations into the Prometheus histograms. `slots`
+        names the slot ids this dispatch touched: their requests' gateway
+        ids land on the StepRecord (so /api/steps?slow=1 names the victims)
+        and a flagged step writes a slow_step event into each victim's
+        flight record."""
         if self._pending_plan_s > 0.0:
             phases["plan"] = phases.get("plan", 0.0) + self._pending_plan_s
             self._pending_plan_s = 0.0
+        request_ids: dict[str, str] | None = None
+        if slots:
+            request_ids = {}
+            for i in slots:
+                r = self.slots[i].request
+                if r is not None:
+                    request_ids[str(i)] = gateway_rid(r.request_id)
         slow = self.step_stats.observe(kind, phases,
                                        active_slots=active_slots,
-                                       tokens=tokens)
+                                       tokens=tokens,
+                                       request_ids=request_ids)
         self.metrics.record_step_phases(phases, slow=slow)
+        if slow and request_ids and self.flightrec.enabled:
+            total = round(sum(phases.values()), 6)
+            seq = self.step_stats.seq
+            for rid in request_ids.values():
+                self.flightrec.emit(rid, "slow_step", kind=kind,
+                                    total_s=total, step_seq=seq)
 
     # Same-bucket pending prompts prefill TOGETHER in one dispatch (padded to
     # a power-of-two group so the jit cache stays at log2 sizes). Bounded so
@@ -1403,7 +1448,10 @@ class EngineCore:
                 r = self.pending.get_nowait()
             except queue.Empty:
                 return
-            self._class_queues[self._priority_of(r)].append(r)
+            cls = self._priority_of(r)
+            self._class_queues[cls].append(r)
+            self._fr_emit(r, "queued", cls=PRIORITY_NAMES[cls],
+                          position=len(self._class_queues[cls]) - 1)
 
     def _queued_requests(self) -> list[Request]:
         out: list[Request] = []
@@ -1484,6 +1532,8 @@ class EngineCore:
         request.finished_at = time.monotonic()
         request.events.put(("done", reason))
         self.metrics.record_request_done(reason)
+        self._fr_emit(request, "finished", reason=reason,
+                      generated=slot.generated)
         self._release_lora(request)
         self._cancelled_effective.discard(request.request_id)
         self._release_cache_entry(slot)
@@ -1502,12 +1552,14 @@ class EngineCore:
         slot.spec_k = 0
         slot.out_tokens = []
 
-    def _park_slot(self, slot_id: int) -> None:
+    def _park_slot(self, slot_id: int, reason: str = "preempt") -> None:
         """Preempt one decoding slot: release its KV (pages back to the pool
         — parking is cheap BECAUSE the layout is paged), capture resume
         state on the request, and requeue it at the front of its class. The
         grammar cursor and drafter park WITH the request; a resume must
-        never re-walk the FSM from its start state."""
+        never re-walk the FSM from its start state. `reason` tags the flight
+        record: preempt (priority arrival) | drain | pages (pool
+        exhaustion)."""
         slot = self.slots[slot_id]
         request = slot.request
         assert request is not None and not slot.prefilling
@@ -1537,6 +1589,8 @@ class EngineCore:
         slot.drafter = None
         slot.spec_k = 0
         self.metrics.record_preemption()
+        self._fr_emit(request, "parked", reason=reason,
+                      generated=len(request.parked.tokens))
         log.info("preempted request %s at %d committed tokens (priority %s)",
                  request.request_id, len(request.parked.tokens),
                  PRIORITY_NAMES[self._priority_of(request)])
@@ -1548,7 +1602,7 @@ class EngineCore:
         victim exists (the caller then holds the request as before)."""
         for i in self._preempt_candidates(prio):
             if self._slot_pages[i]:
-                self._park_slot(i)
+                self._park_slot(i, reason="pages")
                 return True
         return False
 
@@ -1563,6 +1617,7 @@ class EngineCore:
         request.events.put(("error", "deadline exceeded before prefill"))
         self.metrics.record_request_done("error")
         self.metrics.record_deadline_shed()
+        self._fr_emit(request, "shed", reason="deadline")
         self._release_lora(request)
         return True
 
@@ -1733,7 +1788,7 @@ class EngineCore:
                             "%s at %d tokens", request.request_id,
                             int(self._seq_lens[i]),
                         )
-                        self._park_slot(i)
+                        self._park_slot(i, reason="pages")
                         continue
                     log.warning(
                         "page pool exhausted mid-decode; finishing request "
@@ -1743,6 +1798,8 @@ class EngineCore:
                     request.finished_at = time.monotonic()
                     request.events.put(("done", "length"))
                     self.metrics.record_request_done("length")
+                    self._fr_emit(request, "finished", reason="length",
+                                  generated=slot.generated, cause="pages")
                     self._release_lora(request)
                     self._cancelled_effective.discard(request.request_id)
                     self._free_slot_kv(i)
@@ -1819,6 +1876,7 @@ class EngineCore:
             if self._is_cancelled(request):
                 request.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
+                self._fr_emit(request, "finished", reason="cancelled")
                 self._release_lora(request)
                 self._cancelled_effective.discard(request.request_id)
                 handled = True
@@ -1839,6 +1897,8 @@ class EngineCore:
                     request.finished_at = time.monotonic()
                     request.events.put(("done", "length"))
                     self.metrics.record_request_done("length")
+                    self._fr_emit(request, "finished", reason="length",
+                                  cause="capacity_edge_resume")
                     self._release_lora(request)
                     handled = True
                     continue
@@ -1846,6 +1906,8 @@ class EngineCore:
                     ("error", "prompt does not fit slot capacity")
                 )
                 self.metrics.record_request_done("error")
+                self._fr_emit(request, "errored",
+                              message="prompt does not fit slot capacity")
                 self._release_lora(request)
                 handled = True
                 continue
@@ -1854,6 +1916,8 @@ class EngineCore:
             except Exception as e:
                 request.events.put(("error", f"constraint rejected: {e}"))
                 self.metrics.record_request_done("error")
+                self._fr_emit(request, "errored",
+                              message=f"constraint rejected: {e}")
                 self._release_lora(request)
                 handled = True
                 continue
@@ -2057,6 +2121,10 @@ class EngineCore:
             )
             self.kv_copy_dispatches += 1
         self.metrics.record_prefix_hit(use_len)
+        # the uncached suffix prefills via _advance_prefill (its own
+        # prefill_chunk events); this event records the reused head
+        self._fr_emit(request, "prefill_chunk", tokens=0,
+                      cached_tokens=use_len)
 
     # ------------------------------------------------------------ constraints
 
@@ -2403,6 +2471,9 @@ class EngineCore:
         rows: list[int] = []
         new_lens: list[int] = []
         new_lasts: list[int] = []
+        # (request_id, drafted, accepted) per speculating slot — the slot's
+        # request may finish inside the emit loop, so capture the id up front
+        spec_accepts: list[tuple[str, int, int]] = []
         for i in active:
             slot = self.slots[i]
             if slot.first_pending and slot.request is not None:
@@ -2410,6 +2481,7 @@ class EngineCore:
                 self._emit(i, int(tokens[i, 0]), first=True)
             if slot.request is None or slot.prefilling:
                 continue
+            rid_i = slot.request.request_id
             d = drafts.get(i, [])
             # expected emission span (matches until first mismatch, +1 for
             # the correction/bonus sample) — the amortized per-token pacing
@@ -2437,6 +2509,7 @@ class EngineCore:
             emitted_total += emitted_i
             if d:
                 spec_emitted += emitted_i
+                spec_accepts.append((rid_i, len(d), j))
             if slot.request is not None and not slot.prefilling:
                 rows.append(i)
                 new_lens.append(int(self._seq_lens[i]))
@@ -2458,6 +2531,10 @@ class EngineCore:
         self.metrics.record_decode_step(step_s / max(1.0, mean_span),
                                         len(active))
         self.metrics.record_spec_step(drafted, accepted_total, spec_emitted)
+        if self.flightrec.enabled:
+            for rid_i, n_drafted, n_accepted in spec_accepts:
+                self.flightrec.emit(rid_i, "spec_accept",
+                                    drafted=n_drafted, accepted=n_accepted)
         self._record_step(
             "verify",
             {"draft": draft_s,
@@ -2467,6 +2544,7 @@ class EngineCore:
              "fetch": t_emit - t_fetch,
              "emit": time.perf_counter() - t_emit},
             active_slots=len(active), tokens=emitted_total,
+            slots=active,
         )
         return True
 
@@ -2809,12 +2887,19 @@ class EngineCore:
         jax.block_until_ready(logits)
         t_done = time.perf_counter()
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
+        if self.flightrec.enabled:
+            # emit before activation: split mode stages the group and vacates
+            # the prefill slots, after which the requests are unreachable here
+            for _slot_id, request, n in group:
+                self.flightrec.emit(request.request_id, "prefill_chunk",
+                                    tokens=n, cached_tokens=0)
         self._activate_group(group, slot_ids, lens, logits)
         self._record_step(
             "prefill",
             {"dispatch": t_compute - t_dispatch, "compute": t_done - t_compute,
              "emit": time.perf_counter() - t_done},
             active_slots=len(group), tokens=sum(n for _, _, n in group),
+            slots=[s for s, _, _ in group],
         )
 
     def _activate_group(self, group: list[tuple[int, Request, int]],
@@ -2926,6 +3011,7 @@ class EngineCore:
                 slot.out_tokens = list(st.tokens)
                 request.parked = None
                 self.metrics.record_resume()
+                self._fr_emit(request, "resumed", generated=st.generated)
             else:
                 slot.generated = 0
                 slot.out_tokens = []
@@ -2964,6 +3050,8 @@ class EngineCore:
         jax.block_until_ready(logits)  # async dispatch; time real execution
         t_done = time.perf_counter()
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
+        self._fr_emit(request, "prefill_chunk", tokens=n, cached_tokens=0,
+                      cp=True)
         self._record_step(
             "prefill",
             {"dispatch": t_compute - t_dispatch,
@@ -3062,6 +3150,8 @@ class EngineCore:
         self.metrics.record_prefill_step(time.monotonic() - prefill_start)
 
         slot.prefill_pos = start + chunk_len
+        self._fr_emit(request, "prefill_chunk", tokens=chunk_len,
+                      cached_tokens=0, pos=start)
         if slot.prefill_pos >= n:
             slot.prefilling = False
             self._release_cache_entry(slot)  # suffix landed; donor evictable
@@ -3071,6 +3161,7 @@ class EngineCore:
             {"dispatch": t_compute - t_dispatch, "compute": t_done - t_compute,
              "emit": time.perf_counter() - t_done},
             active_slots=1, tokens=chunk_len,
+            slots=[slot_id],
         )
         return True
 
@@ -3266,6 +3357,7 @@ class EngineCore:
                  "fetch": t_emit - t_fetch,
                  "emit": time.perf_counter() - t_emit},
                 active_slots=len(active), tokens=k * len(active),
+                slots=active,
             )
             return True
 
@@ -3332,6 +3424,7 @@ class EngineCore:
              "fetch": t_emit - t_fetch,
              "emit": time.perf_counter() - t_emit},
             active_slots=len(active), tokens=len(active),
+            slots=active,
         )
         return True
 
@@ -3372,6 +3465,8 @@ class EngineCore:
         if self._is_cancelled(request):
             request.finished_at = time.monotonic()
             request.events.put(("done", "cancelled"))
+            self._fr_emit(request, "finished", reason="cancelled",
+                          generated=slot.generated)
             self.metrics.record_request_done("cancelled")
             self._release_lora(request)
             self._cancelled_effective.discard(request.request_id)
@@ -3440,6 +3535,8 @@ class EngineCore:
         if finish is not None:
             request.finished_at = time.monotonic()
             request.events.put(("done", finish))
+            self._fr_emit(request, "finished", reason=finish,
+                          generated=slot.generated)
             self.metrics.record_request_done(finish)
             self._release_lora(request)
             if self.prefix_cache is not None:
@@ -3463,6 +3560,7 @@ class EngineCore:
         for slot_id, slot in enumerate(self.slots):
             if slot.request is not None:
                 slot.request.events.put(("error", message))
+                self._fr_emit(slot.request, "errored", message=message)
                 self.metrics.record_request_done("error")
                 self._release_lora(slot.request)
                 slot.request = None
@@ -3482,6 +3580,7 @@ class EngineCore:
             slot.out_tokens = []
         if self._held_request is not None:
             self._held_request.events.put(("error", message))
+            self._fr_emit(self._held_request, "errored", message=message)
             self.metrics.record_request_done("error")
             self._release_lora(self._held_request)
             self._held_request = None
@@ -3490,12 +3589,14 @@ class EngineCore:
             while q:
                 r = q.popleft()
                 r.events.put(("error", message))
+                self._fr_emit(r, "errored", message=message)
                 self.metrics.record_request_done("error")
                 self._release_lora(r)
         while True:
             try:
                 r = self.pending.get_nowait()
                 r.events.put(("error", message))
+                self._fr_emit(r, "errored", message=message)
                 self.metrics.record_request_done("error")
                 self._release_lora(r)
             except queue.Empty:

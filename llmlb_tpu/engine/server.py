@@ -573,6 +573,7 @@ class EngineAPI:
             kv_cache=core.kv_cache_info(), structured=core.structured_info(),
             perf=core.perf_info(), quant=core.quant_info(),
             sched=core.sched_info(), lora=core.lora_info(),
+            flightrec=core.flightrec.counters(),
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -623,6 +624,22 @@ class EngineAPI:
         slow_only = request.query.get("slow", "") in ("1", "true", "yes")
         body = core.step_stats.snapshot(limit=limit, slow_only=slow_only)
         body["perf"] = core.perf_info()
+        body["flightrec"] = core.flightrec.counters()
+        return web.json_response(body)
+
+    async def request_timeline(self, request: web.Request) -> web.Response:
+        """GET /api/requests/{request_id}/timeline — one request's flight
+        record: every lifecycle event this engine (plus any spool siblings)
+        recorded for the gateway-minted X-Request-Id, sorted causally. The
+        gateway's /api/traces/{id}?view=timeline merges this across every
+        engine the request touched (docs/tracing.md)."""
+        rid = request.match_info["request_id"]
+        core = self.engine.core
+        if not core.flightrec.enabled:
+            return _error(404, "flight recorder disabled (LLMLB_FLIGHTREC=0)")
+        body = core.flightrec.timeline(rid)
+        if body is None:
+            return _error(404, f"no flight record for request '{rid}'")
         return web.json_response(body)
 
     # ------------------------------------------------------------- profiling
@@ -1433,6 +1450,8 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
     app.router.add_get("/metrics", api.prometheus_metrics)
     app.router.add_get("/api/system", api.system)
     app.router.add_get("/api/steps", api.steps)
+    app.router.add_get("/api/requests/{request_id}/timeline",
+                       api.request_timeline)
     app.router.add_post("/api/profile", api.profile_control)
     app.router.add_get("/api/profile", api.profile_status)
     app.router.add_get("/api/profile/{capture_id}", api.profile_artifact)

@@ -326,6 +326,9 @@ class Engine:
             # the bounded run was cut at the emit budget, not a real finish:
             # this stream continues on whichever engine adopts it
             self.core.metrics.record_handoff("emitted")
+            if self.core.flightrec.enabled:
+                self.core.flightrec.emit(request.request_id, "handoff_emitted",
+                                         tokens=len(committed))
             return committed, None
         return committed, finish
 
@@ -418,6 +421,11 @@ class Engine:
         # so the latency diff must stay in the same clock domain
         latency = max(0.0, time.time() - emitted_at) if emitted_at else None
         core.metrics.record_handoff("adopted", latency)
+        if request_id and core.flightrec.enabled:
+            attrs = {"committed": len(committed_ids)}
+            if latency is not None:
+                attrs["wire_latency_s"] = round(latency, 6)
+            core.flightrec.emit(request_id, "adopted", **attrs)
 
         # A handoff that is already terminal (stop string inside the
         # committed text, or a payload whose committed run used up the

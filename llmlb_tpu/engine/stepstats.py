@@ -90,11 +90,14 @@ class StepRecorder:
     # -------------------------------------------------------------- recording
 
     def observe(self, kind: str, phases: dict[str, float], *,
-                active_slots: int = 0, tokens: int = 0) -> bool:
+                active_slots: int = 0, tokens: int = 0,
+                request_ids: dict[str, str] | None = None) -> bool:
         """Record one step; returns True when it was flagged anomalous.
         `phases` maps phase name -> seconds (missing phases count as 0);
         `tokens` is the number of tokens this step delivered to the host
-        (decode: burst x active slots)."""
+        (decode: burst x active slots); `request_ids` maps slot id ->
+        gateway request id for the requests riding this dispatch, so a
+        flagged record NAMES its victims (/api/steps?slow=1)."""
         now = time.time()
         total = sum(phases.values())
         with self._lock:
@@ -122,6 +125,7 @@ class StepRecorder:
                 "phases_s": {p: phases.get(p, 0.0) for p in PHASES},
                 "active_slots": active_slots,
                 "tokens": tokens,
+                "request_ids": dict(request_ids) if request_ids else {},
                 "slow": slow,
             })
             # decode AND verify steps feed the throughput window: both
@@ -132,6 +136,12 @@ class StepRecorder:
         return slow
 
     # --------------------------------------------------------------- reading
+
+    @property
+    def seq(self) -> int:
+        """Sequence number of the most recent record (0 before the first).
+        Lock-free read of an int the GIL keeps coherent."""
+        return self._seq
 
     def window_throughput(self) -> tuple[float, int]:
         """(busy seconds, tokens) over the sliding decode window — the

@@ -327,7 +327,7 @@ async def _handoff_upstream(
     state: AppState, fo: "FailoverController", endpoint: Endpoint, lease,
     model: str, capability: Capability, api_kind: TpsApiKind,
     payload: dict, headers: dict, deadline_at: float | None, is_stream: bool,
-    engine_model: str,
+    engine_model: str, trace=None,
 ):
     """The two-phase disaggregated handoff (docs/disaggregation.md):
 
@@ -399,6 +399,11 @@ async def _handoff_upstream(
     state.metrics.record_handoff(
         "self" if adopter.id == endpoint.id else "adopted"
     )
+    if trace is not None:
+        # names the phase-2 engine so ?view=timeline knows to fetch its
+        # flight record too (tracing.endpoints_touched)
+        trace.mark("handoff_adopt", endpoint=adopter.name,
+                   self_adopt=adopter.id == endpoint.id)
 
     adopt_headers = {"Content-Type": "application/json"}
     if adopter.api_key:
@@ -758,7 +763,7 @@ async def proxy_openai_post(
                     await _handoff_upstream(
                         state, fo, endpoint, lease, canonical, capability,
                         api_kind, payload, headers, deadline_at, is_stream,
-                        engine_model,
+                        engine_model, trace=trace,
                     )
                 )
             else:

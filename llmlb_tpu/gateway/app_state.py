@@ -255,8 +255,13 @@ async def build_app_state(
     # finishes a successful request judges it there (record_slo)
     metrics = GatewayMetrics(slo=SloConfig.from_env())
     admission.metrics = metrics  # admission-retry counter (balancer.py)
-    traces = TraceStore(capacity=env_int("LLMLB_TRACE_BUFFER", 256),
-                        events=events)
+    # Multi-worker: spool completed traces to the gossip dir so ANY worker
+    # answers /api/traces/{id} regardless of which sibling served the
+    # request (same sibling-merge pattern as the /metrics spool below).
+    traces = TraceStore(
+        capacity=env_int("LLMLB_TRACE_BUFFER", 256), events=events,
+        spool_dir=(default_gossip_dir(config.port) if worker.multi else None),
+    )
 
     users = UserStore(db)
     api_keys = ApiKeyStore(db, cache_ttl_s=env_float(

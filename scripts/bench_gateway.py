@@ -2415,6 +2415,53 @@ async def _wait_engine_up(session, port: int, timeout_s: float = 120.0):
     raise RuntimeError(f"engine on port {port} never came up")
 
 
+async def _merged_timeline_check(gw, rids, victim_pid) -> dict:
+    """Durable-streams observability acceptance (docs/tracing.md): every
+    resumed stream's `/api/traces/{id}?view=timeline` merge must carry
+    flight-recorder events from BOTH engine processes — the killed
+    victim's via the shared spool — in causal order, with the stream
+    reaching a terminal event past the cut."""
+    victim_src = f"engine-pid{victim_pid}"
+    admin = await gw.admin_headers()
+    out = {"victim_src": victim_src, "checked": 0, "resumed_verified": 0,
+           "failures": []}
+    for rid in rids:
+        r = await gw.client.get(f"/api/traces/{rid}?view=timeline",
+                                headers=admin)
+        if r.status != 200:
+            await r.release()
+            continue
+        body = await r.json()
+        evs = (body.get("timeline") or {}).get("events") or []
+        if not any(e.get("event") == "stream_resume" for e in evs):
+            continue  # this stream was never cut
+        out["checked"] += 1
+        srcs = {e.get("src") for e in evs if e.get("src") != "gateway"}
+        tss = [float(e.get("ts") or 0.0) for e in evs]
+        victim_evs = [e for e in evs if e.get("src") == victim_src]
+        after = [e for e in evs
+                 if e.get("src") not in ("gateway", victim_src)]
+        problems = []
+        if not victim_evs:
+            problems.append("no events from the killed engine")
+        if len(srcs) < 2:
+            problems.append("timeline is single-engine")
+        if tss != sorted(tss):
+            problems.append("timeline not monotone")
+        if not any(e.get("event") in ("finished", "errored")
+                   for e in after):
+            problems.append("no terminal event past the cut")
+        if victim_evs and after and (
+                max(float(e.get("ts") or 0.0) for e in victim_evs)
+                > min(float(e.get("ts") or 0.0) for e in after)):
+            problems.append("survivor events precede the cut")
+        if problems:
+            out["failures"].append({"rid": rid, "problems": problems})
+        else:
+            out["resumed_verified"] += 1
+    return out
+
+
 async def run_chaos_engine_kill(streams: int = 8,
                                 drills: tuple = ("kill", "drain")) -> dict:
     """The durable-streams chaos drill (docs/resilience.md): REAL engine
@@ -2431,7 +2478,9 @@ async def run_chaos_engine_kill(streams: int = 8,
     for both: seed-0 weights, per-request seeds folded by absolute
     position). Exit code 1 when any bar is missed.
     """
+    import shutil
     import signal
+    import tempfile
 
     from llmlb_tpu.gateway.config import ResilienceConfig
     from llmlb_tpu.gateway.faults import FaultInjector
@@ -2449,9 +2498,16 @@ async def run_chaos_engine_kill(streams: int = 8,
         "drills": {},
     }
 
+    # Shared flight-recorder spool: the SIGKILLed engine's lifecycle
+    # events survive its death, so the survivor answers the victim's
+    # timeline and /api/traces/{id}?view=timeline stays gap-free.
+    flightrec_spool = tempfile.mkdtemp(prefix="llmlb-chaos-flightrec-")
+
     def spawn(extra_env=None):
         port = _free_port()
-        proc = _spawn_engine_process(port, extra_env=extra_env)
+        env = {"LLMLB_FLIGHTREC_SPOOL": flightrec_spool}
+        env.update(extra_env or {})
+        proc = _spawn_engine_process(port, extra_env=env)
         procs.append(proc)
         return port, proc
 
@@ -2549,12 +2605,14 @@ async def run_chaos_engine_kill(streams: int = 8,
             return "".join(parts)
 
         async def one_stream(i: int, first_byte_evt: asyncio.Event,
-                             counter: list) -> dict:
-            out = {"ok": False, "identical": False, "error": None}
+                             counter: list, rid: str) -> dict:
+            out = {"ok": False, "identical": False, "error": None,
+                   "rid": rid}
             try:
                 r = await gw.client.post("/v1/chat/completions",
                                          json=body_for(i, stream=True),
-                                         headers=headers)
+                                         headers={**headers,
+                                                  "X-Request-Id": rid})
                 if r.status != 200:
                     out["error"] = f"http_{r.status}"
                     return out
@@ -2592,8 +2650,11 @@ async def run_chaos_engine_kill(streams: int = 8,
                 gw.state.load_manager.clear_tps_for_endpoint(e["ep"].id)
             evt = asyncio.Event()
             counter = [0]
-            tasks = [asyncio.create_task(one_stream(i, evt, counter))
-                     for i in range(streams)]
+            tasks = [
+                asyncio.create_task(
+                    one_stream(i, evt, counter, f"chaos-{name}-{i}"))
+                for i in range(streams)
+            ]
             await asyncio.wait_for(evt.wait(), timeout=60)
             victim = next(e for e in engines if e["alive"])
             victim["proc"].send_signal(victim_sig)
@@ -2609,6 +2670,9 @@ async def run_chaos_engine_kill(streams: int = 8,
                 "token_identical": identical,
                 "success_rate": round(ok / streams, 4),
                 "errors": [o["error"] for o in outs if o["error"]],
+                "timeline": await _merged_timeline_check(
+                    gw, [o["rid"] for o in outs if o["ok"]],
+                    victim["proc"].pid),
             }
 
         summary0 = gw.state.metrics.summary()
@@ -2671,6 +2735,14 @@ async def run_chaos_engine_kill(streams: int = 8,
         for name, d in result["drills"].items():
             bars.append(d["success_rate"] >= 0.99)
             bars.append(d["token_identical"] == d["client_success"])
+            # no resumed stream may show a broken merged timeline
+            bars.append(not d["timeline"]["failures"])
+        if "sigkill" in result["drills"]:
+            # the SIGKILL acceptance: at least one resumed stream yields a
+            # single merged timeline spanning both engine processes
+            bars.append(
+                result["drills"]["sigkill"]["timeline"]["resumed_verified"]
+                >= 1)
         if "sigterm_drain" in result["drills"]:
             bars.append(not result["drills"]["sigterm_drain"]["errors"])
         # the drill is vacuous unless at least one stream actually resumed
@@ -2688,6 +2760,7 @@ async def run_chaos_engine_kill(streams: int = 8,
                 p.wait(timeout=10)
             except Exception:
                 pass
+        shutil.rmtree(flightrec_spool, ignore_errors=True)
         await gw.close()
 
 

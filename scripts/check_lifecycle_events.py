@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Fail when a scheduler terminal path lacks a flight-recorder emit.
+
+The flight recorder (llmlb_tpu/engine/flightrec.py) is only trustworthy
+if EVERY terminal edge a request can cross — finish, error, shed, park —
+writes an event: a missing emit turns a merged timeline into a silent
+gap, which reads as "the request vanished". This checker walks
+``llmlb_tpu/engine/scheduler.py`` with ``ast`` and enforces, per function:
+
+- every ``<request>.events.put(("done", ...))`` / ``(("error", ...))``
+  call (the terminal client-visible edges) is matched by at least as many
+  flight-recorder emits (``self._fr_emit(...)`` or
+  ``self.flightrec.emit(...)``) in the same function;
+- ``_park_slot`` (the preemption/drain park edge — terminal for the slot,
+  resumable for the request) contains a ``parked`` emit.
+
+Functions with no terminal puts are not required to emit anything. The
+per-function >= pairing is deliberate: an emit belongs NEXT TO the put it
+mirrors, and a function that gains a second terminal path without a
+second emit fails here. Wired as a tier-1 test
+(tests/test_lifecycle_events.py); standalone:
+
+    python scripts/check_lifecycle_events.py
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCHEDULER = REPO / "llmlb_tpu" / "engine" / "scheduler.py"
+
+TERMINAL_KINDS = ("done", "error")
+
+
+def _is_terminal_put(node: ast.Call) -> bool:
+    """``<anything>.events.put((<"done"|"error">, ...))``."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "put"):
+        return False
+    if not (isinstance(f.value, ast.Attribute) and f.value.attr == "events"):
+        return False
+    if not node.args:
+        return False
+    arg = node.args[0]
+    if not (isinstance(arg, ast.Tuple) and arg.elts):
+        return False
+    head = arg.elts[0]
+    return (isinstance(head, ast.Constant)
+            and head.value in TERMINAL_KINDS)
+
+
+def _is_fr_emit(node: ast.Call) -> bool:
+    """``self._fr_emit(...)`` or ``<anything>.flightrec.emit(...)``."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "_fr_emit":
+        return True
+    if (isinstance(f, ast.Attribute) and f.attr == "emit"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "flightrec"):
+        return True
+    return False
+
+
+def _emits_event(func: ast.FunctionDef, event: str) -> bool:
+    """True when the function contains an ``_fr_emit``/``flightrec.emit``
+    call whose event argument is the given string constant."""
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Call) and _is_fr_emit(node)):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and arg.value == event:
+                return True
+    return False
+
+
+def check_scheduler(path: Path = SCHEDULER) -> list[tuple[int, str]]:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:  # broken file: other tooling reports it better
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    findings: list[tuple[int, str]] = []
+    park_seen = False
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        puts = 0
+        emits = 0
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_terminal_put(node):
+                puts += 1
+            elif _is_fr_emit(node):
+                emits += 1
+        if puts and emits < puts:
+            findings.append((
+                func.lineno,
+                f"{func.name}(): {puts} terminal events.put but only "
+                f"{emits} flight-recorder emit(s) — every finish/error/"
+                f"shed path must emit next to its put",
+            ))
+        if func.name == "_park_slot":
+            park_seen = True
+            if not _emits_event(func, "parked"):
+                findings.append((
+                    func.lineno,
+                    "_park_slot(): park edge lacks a 'parked' "
+                    "flight-recorder emit",
+                ))
+    if not park_seen:
+        findings.append((0, "_park_slot() not found in scheduler.py — "
+                            "checker needs updating for the rename"))
+    return findings
+
+
+def main() -> int:
+    findings = check_scheduler()
+    for lineno, what in findings:
+        rel = SCHEDULER.relative_to(REPO)
+        print(f"{rel}:{lineno}: {what}", file=sys.stderr)
+    if findings:
+        print(f"\n{len(findings)} uninstrumented lifecycle path(s) found",
+              file=sys.stderr)
+        return 1
+    print("every scheduler terminal path carries a flight-recorder emit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,9 @@ def engine_metric_names() -> set[str]:
                "queued_by_role": {"prefill": 0, "decode": 0}},
         lora={"enabled": True, "resident": ["sample"],
               "available": ["sample"], "max_adapters": 8},
+        flightrec={"enabled": True, "events_total": 0,
+                   "events_dropped_total": 0, "requests_tracked": 0,
+                   "queue_seconds_total": 0.0, "service_seconds_total": 0.0},
     )
     return set(_TYPE_RE.findall(text))
 

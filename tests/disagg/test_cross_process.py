@@ -164,3 +164,46 @@ def test_malformed_payload_is_a_400_not_a_crash(rig):
         })
         assert r.status == 200
     loop.run_until_complete(run())
+
+
+def test_handoff_flight_records_pair_across_processes(rig):
+    """Each side of the wire records its half of the handoff — `emitted`
+    on the prefill engine, `adopted` on the decode engine — keyed by the
+    same gateway request id, with cause stamped before effect, so the
+    gateway's `?view=timeline` merge can join them (docs/tracing.md)."""
+    loop, cp, cd, _pre, _dec = rig
+    rid = "trace-xproc-handoff-1"
+
+    async def run():
+        body = {"messages": [{"role": "user",
+                              "content": "tell me about wires"}],
+                "temperature": 0, "max_tokens": 12}
+        r = await cp.post("/v1/handoff/prefill",
+                          json={**body, "handoff_tokens": 1},
+                          headers={"X-Request-Id": rid})
+        assert r.status == 200, await r.text()
+        env = await r.json()
+        r = await cd.post("/v1/handoff", json={
+            "handoff": env["handoff"], "stream": False,
+            "tool_name": env.get("tool_name"),
+        })
+        assert r.status == 200, await r.text()
+
+        r = await cp.get(f"/api/requests/{rid}/timeline")
+        assert r.status == 200, await r.text()
+        emit_tl = await r.json()
+        r = await cd.get(f"/api/requests/{rid}/timeline")
+        assert r.status == 200, await r.text()
+        adopt_tl = await r.json()
+        return emit_tl, adopt_tl
+
+    emit_tl, adopt_tl = loop.run_until_complete(run())
+    emitted = [e for e in emit_tl["events"]
+               if e["event"] == "handoff_emitted"]
+    adopted = [e for e in adopt_tl["events"] if e["event"] == "adopted"]
+    assert len(emitted) == 1 and len(adopted) == 1
+    # the join key both sides share is the gateway rid (the fixture runs
+    # both engines in-process, so the pid-based source tag cannot differ)
+    assert emitted[0]["request_id"] == adopted[0]["request_id"] == rid
+    assert emitted[0]["ts"] <= adopted[0]["ts"]
+    assert adopted[0]["attrs"]["committed"] >= 1

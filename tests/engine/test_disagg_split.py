@@ -167,3 +167,34 @@ def test_split_requires_paged_layout_and_two_slots():
     with pytest.raises(ValueError, match="2 slots"):
         EngineCore(get_preset("debug-tiny"), role="split",
                    num_slots=1, slot_capacity=64, prefill_buckets=(16,))
+
+
+def test_split_flight_record_pairs_stage_with_adopt(pair):
+    """Observability twin (docs/tracing.md): a split run's flight record
+    shows the handoff as an emit/adopt pair — `staged` on the prefill
+    loop, `adopted` on the decode loop — in causal order, inside one
+    request timeline keyed by the gateway request id."""
+    _, split = pair
+    rid = "trace-split-fr-1"
+
+    async def run():
+        ids = split.tokenizer.encode("tell me about staged adoption")
+        params = SamplingParams(temperature=0.0, max_tokens=8)
+        got = await split.complete(ids, params, request_id=rid)
+        assert got.text
+    asyncio.run(run())
+
+    tl = split.core.flightrec.timeline(rid)
+    assert tl is not None, "split request left no flight record"
+    names = [e["event"] for e in tl["events"]]
+    assert "staged" in names and "adopted" in names
+    assert names.index("staged") < names.index("adopted")
+    assert names.count("staged") == names.count("adopted") == 1
+    # the pair brackets the lifecycle: prefill before, finish after
+    assert names.index("prefill_chunk") < names.index("staged")
+    assert names[-1] == "finished"
+    adopted = next(e for e in tl["events"] if e["event"] == "adopted")
+    assert adopted["attrs"]["in_process"] is True
+    assert adopted["attrs"]["staged_s"] >= 0
+    tss = [e["ts"] for e in tl["events"]]
+    assert tss == sorted(tss)

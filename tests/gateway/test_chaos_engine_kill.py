@@ -7,19 +7,32 @@ engine — every cut stream must resume token-identically on the survivor —
 and (b) SIGTERM + drain another — zero client-visible errors. The
 mock-level unit tests live in test_stream_resume.py; this is the
 end-to-end proof against real process death.
+
+The drill's observability twin rides the same run: engines share a
+flight-recorder spool (LLMLB_FLIGHTREC_SPOOL), so after each drill the
+gateway's /api/traces/{id}?view=timeline merge is checked for every
+resumed stream — events from BOTH engine processes, causally ordered,
+with no gap past the cut (docs/tracing.md).
 """
 
 import asyncio
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "scripts"))
 
 
-def test_chaos_engine_kill_and_drain():
+@pytest.fixture(scope="module")
+def drill_result():
     import bench_gateway
 
-    result = asyncio.run(bench_gateway.run_chaos_engine_kill(streams=8))
+    return asyncio.run(bench_gateway.run_chaos_engine_kill(streams=8))
+
+
+def test_chaos_engine_kill_and_drain(drill_result):
+    result = drill_result
     assert result["passed"], result
 
     kill = result["drills"]["sigkill"]
@@ -35,3 +48,20 @@ def test_chaos_engine_kill_and_drain():
     assert result["stream_interruptions"] >= 1, result
     assert result["stream_resumes"].get("success", 0) >= 1, result
     assert result["stream_resumed_tokens"] >= 0
+
+
+def test_chaos_merged_timeline_spans_both_engines(drill_result):
+    """PR 16 twin: a SIGKILL-resumed stream's merged timeline must carry
+    flight-recorder events from BOTH engine processes — the victim's via
+    the shared spool — in causal order (no survivor event before the
+    cut, a terminal event past it)."""
+    kill_tl = drill_result["drills"]["sigkill"]["timeline"]
+    assert kill_tl["resumed_verified"] >= 1, drill_result
+    assert kill_tl["failures"] == [], drill_result
+    # checked == every stream the gateway recorded a resume for
+    assert kill_tl["checked"] == kill_tl["resumed_verified"], drill_result
+
+    # the drain drill parks instead of dying; its resumed streams must
+    # merge just as cleanly (park on the victim, adopt on the survivor)
+    drain_tl = drill_result["drills"]["sigterm_drain"]["timeline"]
+    assert drain_tl["failures"] == [], drill_result

@@ -16,6 +16,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -457,6 +458,92 @@ def test_so_reuseport_two_workers_serve_one_port(tmp_path):
         with urllib.request.urlopen(f"{base}/api/health", timeout=2) as r:
             body = json.loads(r.read().decode())
         assert body["worker"]["count"] == 2
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+
+def test_forked_workers_all_answer_trace_lookup(tmp_path):
+    """Regression: `/api/traces/{id}` used to 404 whenever SO_REUSEPORT
+    handed the lookup to the worker that didn't serve the request. With
+    the trace spool (gossip dir), EVERY worker must answer. Each urllib
+    call opens a fresh connection, so repeated lookups land on both
+    workers — one 404 fails the test."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("SO_REUSEPORT unavailable on this platform")
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "LLMLB_DATA_DIR": str(tmp_path / "data"),
+        "LLMLB_LOG_DIR": str(tmp_path / "logs"),
+        "LLMLB_GOSSIP_DIR": str(tmp_path / "bus"),
+        "LLMLB_ADMIN_PASSWORD": "multiworker1",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "llmlb_tpu.gateway.server", "serve",
+         "--host", "127.0.0.1", "--port", str(port), "--workers", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    base = f"http://127.0.0.1:{port}"
+
+    def _post(path, payload, headers=None):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, {}
+
+    try:
+        deadline = time.monotonic() + 30
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                with urllib.request.urlopen(f"{base}/health", timeout=1) as r:
+                    if r.status == 200:
+                        up = True
+                        break
+            except OSError:
+                time.sleep(0.2)
+        assert up, (
+            f"gateway never came up: "
+            f"{proc.stderr.read().decode(errors='replace')[-2000:]}"
+            if proc.poll() is not None else "gateway never answered /health"
+        )
+        status, body = _post("/api/auth/login",
+                             {"username": "admin",
+                              "password": "multiworker1"})
+        assert status == 200, f"login failed: {status}"
+        auth = {"Authorization": f"Bearer {body['token']}"}
+
+        # Any /v1 request is traced — even this unauthenticated 401 — and
+        # exactly one worker serves (and spools) it.
+        rid = "trace-fork-regress-1"
+        status, _ = _post("/v1/chat/completions",
+                          {"model": "nope", "messages": []},
+                          headers={"X-Request-Id": rid})
+        assert status in (401, 403, 404), status
+
+        # 12 fresh connections: with one 404-ing worker the chance all 12
+        # land on the serving sibling is 2^-12.
+        for i in range(12):
+            req = urllib.request.Request(f"{base}/api/traces/{rid}",
+                                         headers=auth)
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 200, f"lookup {i} failed: {r.status}"
+                got = json.loads(r.read().decode())
+            assert got["trace_id"] == rid
     finally:
         if proc.poll() is None:
             proc.send_signal(signal.SIGTERM)

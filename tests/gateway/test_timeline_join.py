@@ -1,0 +1,387 @@
+"""Cross-process timeline join + multi-worker trace spool (docs/tracing.md).
+
+`/api/traces/{id}?view=timeline` merges the gateway's own spans with the
+flight-recorder events of every engine the request touched into one
+causally ordered timeline; `?format=chrome` exports the merge as Chrome
+trace-event JSON (Perfetto-loadable). The TraceStore spool lets any
+worker of a multi-worker gateway answer `/api/traces/{id}` for requests
+a sibling served — the SO_REUSEPORT blind spot.
+"""
+
+import json
+import os
+import time
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from llmlb_tpu.gateway.app_state import build_app_state
+from llmlb_tpu.gateway.config import ServerConfig
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.tracing import (
+    TraceStore,
+    chrome_trace,
+    endpoints_touched,
+    merge_timeline,
+    repair_causal_order,
+    _gateway_events,
+)
+from llmlb_tpu.gateway.worker import WorkerInfo
+
+from tests.support import GatewayHarness, MockOpenAIEndpoint
+
+
+def _trace_dict(trace_id="trace-unit-1", started_at=1000.0, spans=None,
+                endpoint_name=None):
+    return {
+        "trace_id": trace_id,
+        "started_at": started_at,
+        "spans": spans or [],
+        "endpoint_name": endpoint_name,
+    }
+
+
+def _span(name, start_ms, duration_ms=0.0, **attrs):
+    span = {"name": name, "start_ms": start_ms, "duration_ms": duration_ms}
+    if attrs:
+        span["attrs"] = attrs
+    return span
+
+
+# ------------------------------------------------------------ merge: units
+
+
+def test_endpoints_touched_first_touch_order_and_dedup():
+    trace = _trace_dict(spans=[
+        _span("endpoint_select", 1.0, endpoint="ep-a"),
+        _span("handoff_adopt", 5.0, endpoint="ep-b", self_adopt=False),
+        _span("stream_resume", 9.0, endpoint="ep-b"),
+    ])
+    assert endpoints_touched(trace) == ["ep-a", "ep-b"]
+
+
+def test_endpoints_touched_falls_back_to_endpoint_name():
+    # older traces (or error paths) may carry no endpoint-attributed spans
+    trace = _trace_dict(spans=[_span("auth", 0.0)], endpoint_name="ep-z")
+    assert endpoints_touched(trace) == ["ep-z"]
+    assert endpoints_touched(_trace_dict()) == []
+
+
+def test_gateway_events_carry_wall_clock_and_durations():
+    trace = _trace_dict(spans=[
+        _span("auth", 1.0, duration_ms=0.5),
+        _span("queue_wait", 3.0, duration_ms=12.0),
+        _span("endpoint_select", 16.0, endpoint="ep-a"),
+    ])
+    events = _gateway_events(trace)
+    assert [e["event"] for e in events] == ["auth", "queue_wait",
+                                           "endpoint_select"]
+    assert all(e["src"] == "gateway" for e in events)
+    assert [e["seq"] for e in events] == [1, 2, 3]
+    assert events[1]["ts"] == 1000.003  # started_at + start_ms/1000
+    assert events[1]["duration_s"] == 0.012
+    assert events[2]["attrs"]["endpoint"] == "ep-a"
+    assert "duration_s" not in events[2]  # marks are instants
+
+
+def test_merge_timeline_repairs_cross_source_skew():
+    """The disagg acceptance shape: the adopting engine's clock runs
+    behind the emitter's, stamping `adopted` before `handoff_emitted`.
+    The merge must not show the effect before its cause."""
+    trace = _trace_dict(spans=[
+        _span("endpoint_select", 1.0, endpoint="prefill-ep"),
+    ])
+    engine_events = [
+        {"seq": 5, "ts": 1000.050, "src": "engine-pid1",
+         "event": "handoff_emitted", "request_id": "trace-unit-1",
+         "endpoint": "prefill-ep"},
+        {"seq": 2, "ts": 1000.020, "src": "engine-pid2",
+         "event": "adopted", "request_id": "trace-unit-1",
+         "endpoint": "decode-ep"},
+    ]
+    tl = merge_timeline(trace, engine_events, sources=[])
+    order = [e["event"] for e in tl["events"]]
+    assert order.index("handoff_emitted") < order.index("adopted")
+    adopted = next(e for e in tl["events"] if e["event"] == "adopted")
+    assert adopted["ts_adjusted"] is True
+    assert adopted["ts"] > 1000.050
+    assert tl["trace_id"] == "trace-unit-1"
+    assert tl["event_count"] == len(tl["events"]) == 3
+
+
+def test_repair_clamps_failover_park_resume_across_sources():
+    """SIGKILL failover: park recorded by the dead engine's spool, resume
+    by the survivor — skew must not order the resume first."""
+    events = [
+        {"seq": 9, "ts": 50.0, "src": "engine-pid1", "event": "parked"},
+        {"seq": 1, "ts": 49.5, "src": "engine-pid2", "event": "resumed"},
+    ]
+    repair_causal_order(events)
+    assert [e["event"] for e in events] == ["parked", "resumed"]
+    assert events[1]["ts_adjusted"] is True
+
+
+def test_repair_leaves_same_source_cycles_alone():
+    """One engine legitimately parks and resumes the same request many
+    times (preemption churn); per-process seq already orders those and
+    the repair must not touch them."""
+    events = [
+        {"seq": i + 1, "ts": float(i), "src": "engine-pid1", "event": ev}
+        for i, ev in enumerate(["parked", "resumed", "parked", "resumed"])
+    ]
+    before = [e["ts"] for e in events]
+    repair_causal_order(events)
+    assert [e["ts"] for e in events] == before
+    assert not any(e.get("ts_adjusted") for e in events)
+
+
+def test_chrome_trace_export_shape():
+    timeline = {"events": [
+        {"seq": 1, "ts": 100.0, "src": "gateway", "event": "queue_wait",
+         "request_id": "trace-u", "duration_s": 0.012},
+        {"seq": 1, "ts": 100.005, "src": "engine-pid1", "event": "admitted",
+         "request_id": "trace-u", "endpoint": "ep-a", "ts_adjusted": True},
+    ]}
+    out = chrome_trace(timeline)
+    assert out["displayTimeUnit"] == "ms"
+    json.dumps(out)  # must be serializable as-is
+    meta = [e for e in out["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {
+        "gateway", "ep-a (engine-pid1)"}
+    assert len({m["pid"] for m in meta}) == 2  # one process row per source
+    slice_ = next(e for e in out["traceEvents"]
+                  if e["ph"] == "X")
+    assert slice_["name"] == "queue_wait" and slice_["dur"] == 12000.0
+    assert slice_["ts"] == 0.0  # offsets are µs from the earliest event
+    instant = next(e for e in out["traceEvents"] if e["ph"] == "i")
+    assert instant["name"] == "admitted" and instant["ts"] == 5000.0
+    assert instant["args"]["ts_adjusted"] is True
+    assert instant["args"]["request_id"] == "trace-u"
+
+
+# ------------------------------------------------------- TraceStore spool
+
+
+def test_spool_lets_a_sibling_store_answer(tmp_path):
+    a = TraceStore(timeline_interval=1, spool_dir=str(tmp_path))
+    b = TraceStore(timeline_interval=1, spool_dir=str(tmp_path))
+    t = a.start("trace-sib-1", "POST", "/v1/chat/completions")
+    t.mark("endpoint_select", endpoint="ep-a")
+    a.finish(t, 200)
+    got = b.get("trace-sib-1")  # b never saw the request
+    assert got is not None and got["spooled"] is True
+    assert got["in_flight"] is False and got["status"] == 200
+    assert any(s["name"] == "endpoint_select" for s in got["spans"])
+    # the serving store answers from memory, not its own spool file
+    local = a.get("trace-sib-1")
+    assert local["in_flight"] is False and "spooled" not in local
+
+
+def test_spool_rejects_torn_and_mismatched_files(tmp_path):
+    store = TraceStore(timeline_interval=1, spool_dir=str(tmp_path))
+    (tmp_path / "trace-trace-torn.json").write_text('{"trace_id": "trace-t')
+    assert store.get("trace-torn") is None
+    (tmp_path / "trace-trace-lied.json").write_text(
+        json.dumps({"trace_id": "other"}))
+    assert store.get("trace-lied") is None
+
+
+def test_spool_never_reads_outside_its_dir(tmp_path):
+    store = TraceStore(timeline_interval=1, spool_dir=str(tmp_path))
+    # ids with path separators fail the id regex before any open()
+    assert store.get("../../etc/passwd") is None
+    assert store.get("a/b") is None
+
+
+def test_spool_prunes_past_retention(tmp_path):
+    store = TraceStore(timeline_interval=1, spool_dir=str(tmp_path))
+    t = store.start("trace-old-1", "POST", "/v1/chat/completions")
+    store.finish(t, 200)
+    path = tmp_path / "trace-trace-old-1.json"
+    assert path.exists()
+    stale = time.time() - TraceStore.SPOOL_RETENTION_S - 5
+    os.utime(path, (stale, stale))
+    store._prune_spool()
+    assert not path.exists()
+    assert store.spool_errors_total == 0
+
+
+def test_spool_write_failure_counts_not_crashes(tmp_path):
+    blocked = tmp_path / "not-a-dir"
+    blocked.write_text("file where the spool dir should be")
+    store = TraceStore(timeline_interval=1, spool_dir=str(blocked))
+    t = store.start("trace-err-1", "POST", "/v1/chat/completions")
+    store.finish(t, 200)  # must not raise
+    assert store.spool_errors_total == 1
+    assert store.get("trace-err-1")["in_flight"] is False  # ring still works
+
+
+async def test_sibling_worker_state_answers_trace_lookup(tmp_path,
+                                                         monkeypatch):
+    """Two AppStates wired like forked workers (shared gossip dir): a
+    trace finished on worker 0 is readable through worker 1's store —
+    the exact `/api/traces/{id}` 404 this PR fixes."""
+    monkeypatch.setenv("LLMLB_GOSSIP_DIR", str(tmp_path / "bus"))
+    db_path = str(tmp_path / "gw.db")
+    config = ServerConfig(port=45891, database_url=db_path)
+    s0 = await build_app_state(config, db=Database(db_path),
+                               start_background=False,
+                               worker=WorkerInfo(index=0, count=2))
+    s1 = await build_app_state(config, db=Database(db_path),
+                               start_background=False,
+                               worker=WorkerInfo(index=1, count=2))
+    try:
+        assert s0.traces.spool_dir
+        assert s0.traces.spool_dir == s1.traces.spool_dir
+        t = s0.traces.start("trace-xworker-1", "POST",
+                            "/v1/chat/completions")
+        s0.traces.finish(t, 200)
+        got = s1.traces.get("trace-xworker-1")
+        assert got is not None and got["spooled"] is True
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# --------------------------------------------------------------- e2e view
+
+
+class MockEngineWithTimeline(MockOpenAIEndpoint):
+    """OpenAI mock that also speaks the engine observability surface:
+    ``GET /api/requests/{id}/timeline`` returns canned flight-recorder
+    events stamped just after the chat request it served."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.src = "engine-pid99991"
+        self.last_chat_ts: float | None = None
+
+    async def start(self) -> "MockEngineWithTimeline":
+        app = web.Application()
+        app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_get("/api/requests/{request_id}/timeline",
+                           self._timeline)
+        self.server = TestServer(app)
+        await self.server.start_server()
+        return self
+
+    async def _chat(self, request):
+        self.last_chat_ts = time.time()
+        return await super()._chat(request)
+
+    async def _timeline(self, request):
+        rid = request.match_info["request_id"]
+        ts = self.last_chat_ts or time.time()
+        events = [
+            {"seq": 1, "ts": round(ts + 0.001, 6), "src": self.src,
+             "event": "admitted", "request_id": rid},
+            {"seq": 2, "ts": round(ts + 0.002, 6), "src": self.src,
+             "event": "prefill_chunk", "request_id": rid,
+             "attrs": {"tokens": 7, "cached_tokens": 0}},
+            {"seq": 3, "ts": round(ts + 0.004, 6), "src": self.src,
+             "event": "finished", "request_id": rid,
+             "attrs": {"reason": "stop"}},
+        ]
+        return web.json_response({"request_id": rid, "source": self.src,
+                                  "events": events})
+
+
+async def test_timeline_view_joins_engine_events_e2e():
+    gw = await GatewayHarness.create()
+    engine = await MockEngineWithTimeline(model="m1").start()
+    try:
+        gw.register_mock(engine.url, ["m1"], name="ep-a")
+        rid = "trace-join-e2e-1"
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1", "messages": [{"role": "user",
+                                               "content": "hi"}]},
+            headers={**(await gw.inference_headers()), "X-Request-Id": rid},
+        )
+        assert resp.status == 200, await resp.text()
+
+        resp = await gw.client.get(f"/api/traces/{rid}?view=timeline",
+                                   headers=await gw.admin_headers())
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        tl = body["timeline"]
+        assert tl["endpoints"] == ["ep-a"]
+        (src_info,) = tl["sources"]
+        assert src_info["ok"] is True and src_info["events"] == 3
+        assert src_info["source"] == engine.src
+
+        events = tl["events"]
+        by_src = {e["src"] for e in events}
+        assert by_src == {"gateway", engine.src}
+        engine_evs = [e for e in events if e["src"] == engine.src]
+        assert [e["event"] for e in engine_evs] == [
+            "admitted", "prefill_chunk", "finished"]
+        assert all(e["endpoint"] == "ep-a" for e in engine_evs)
+        # the merge is ordered: selection happens before engine admission
+        order = [e["event"] for e in events]
+        assert order.index("endpoint_select") < order.index("admitted")
+        tss = [e["ts"] for e in events]
+        assert tss == sorted(tss)
+    finally:
+        await engine.stop()
+        await gw.close()
+
+
+async def test_chrome_format_exports_perfetto_loadable_json():
+    gw = await GatewayHarness.create()
+    engine = await MockEngineWithTimeline(model="m1").start()
+    try:
+        gw.register_mock(engine.url, ["m1"], name="ep-a")
+        rid = "trace-chrome-e2e-1"
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1", "messages": [{"role": "user",
+                                               "content": "hi"}]},
+            headers={**(await gw.inference_headers()), "X-Request-Id": rid},
+        )
+        assert resp.status == 200, await resp.text()
+
+        resp = await gw.client.get(f"/api/traces/{rid}?format=chrome",
+                                   headers=await gw.admin_headers())
+        assert resp.status == 200
+        body = await resp.json()
+        events = body["traceEvents"]
+        assert events and body["displayTimeUnit"] == "ms"
+        names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+        assert "gateway" in names
+        assert f"ep-a ({engine.src})" in names
+        assert all(e["ph"] in ("M", "X", "i") for e in events)
+        assert all(e["ts"] >= 0 for e in events if e["ph"] != "M")
+    finally:
+        await engine.stop()
+        await gw.close()
+
+
+async def test_timeline_view_reports_unreachable_engine():
+    """An endpoint with no timeline surface (or a dead one) degrades to a
+    per-source error — the gateway's own events still render."""
+    gw = await GatewayHarness.create()
+    upstream = await MockOpenAIEndpoint(model="m1").start()
+    try:
+        gw.register_mock(upstream.url, ["m1"], name="ep-a")
+        rid = "trace-degraded-1"
+        resp = await gw.client.post(
+            "/v1/chat/completions",
+            json={"model": "m1", "messages": [{"role": "user",
+                                               "content": "hi"}]},
+            headers={**(await gw.inference_headers()), "X-Request-Id": rid},
+        )
+        assert resp.status == 200, await resp.text()
+
+        resp = await gw.client.get(f"/api/traces/{rid}?view=timeline",
+                                   headers=await gw.admin_headers())
+        assert resp.status == 200
+        tl = (await resp.json())["timeline"]
+        (src_info,) = tl["sources"]
+        assert src_info["ok"] is False and "404" in src_info["error"]
+        assert tl["events"] and all(e["src"] == "gateway"
+                                    for e in tl["events"])
+    finally:
+        await upstream.stop()
+        await gw.close()
