@@ -39,9 +39,17 @@ def handoff_payload(
     *,
     stop: list[str] | None = None,
     request_id: str | None = None,
+    kv_pages: dict | None = None,
 ) -> dict:
-    """JSON-safe wire form of an in-flight request at its handoff point."""
-    return {
+    """JSON-safe wire form of an in-flight request at its handoff point.
+
+    ``kv_pages`` optionally carries the origin's serialized KV page payload
+    (engine/kv_transfer.py) so the adopter can land pages instead of
+    replaying the prefill. It rides as a sibling of the token fields — an
+    OLDER adopter ignores unknown top-level keys and replays as before, a
+    NEWER one validates the payload's own versioned header, so the
+    attachment needs no handoff wire-version bump."""
+    out = {
         "version": HANDOFF_WIRE_VERSION,
         "request_id": request_id,
         "prompt_ids": [int(t) for t in prompt_ids],
@@ -53,6 +61,9 @@ def handoff_payload(
         # docs/disaggregation.md)
         "t": time.time(),
     }
+    if kv_pages is not None:
+        out["kv_pages"] = kv_pages
+    return out
 
 
 def _token_list(payload: dict, key: str, *, min_len: int = 0) -> list[int]:

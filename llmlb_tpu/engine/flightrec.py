@@ -17,7 +17,15 @@ engine — every lifecycle edge the scheduler crosses:
                    (/v1/handoff, /v1/resume, or the in-process split)
   parked           slot preempted (reason: preempt | drain | pages) with
                    generated-token count — resumable state retained
-  resumed          a parked request re-activated (chunk-prefill replay)
+  resumed          a parked request re-activated (chunk-prefill replay,
+                   or page restore when KV travelled as bytes)
+  kv_shipped       this request's KV pages serialized D2H for transport
+                   (tokens, pages, bytes — handoff/resume export)
+  kv_spilled       parked-slot pages serialized into the host-RAM offload
+                   tier instead of being dropped (reason, tokens, bytes)
+  kv_restored      serialized pages landed H2D into this engine's pool —
+                   decode continues with zero prefill dispatches
+                   (source: wire | offload; kind: stream | prefix)
   lora_acquire     adapter pinned for the request (+ load wait seconds)
   spec_accept      one speculative verify step's drafted/accepted counts
   shed             dropped before prefill (deadline exceeded)
@@ -65,7 +73,8 @@ from collections import OrderedDict, deque
 # The lifecycle taxonomy (docs/tracing.md documents each event's fields).
 EVENTS = (
     "admitted", "queued", "prefill_chunk", "staged", "handoff_emitted",
-    "adopted", "parked", "resumed", "lora_acquire", "spec_accept",
+    "adopted", "parked", "resumed", "kv_shipped", "kv_spilled",
+    "kv_restored", "lora_acquire", "spec_accept",
     "shed", "finished", "errored", "slow_step",
 )
 

@@ -31,6 +31,16 @@ COMPILE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0,
 PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 
+# Why a KV page transfer fell back to chunk-prefill replay
+# (docs/kv-cache.md): shipping knob off / split mode / multihost
+# (disabled), no payload arrived with shipping on (absent), wire version
+# skew (version), pool dtype or page-size or model-geometry mismatch
+# (dtype / page_size / geometry), adopter could not reserve pages
+# (capacity), malformed payload (error). Closed set: the fallback counter
+# renders one series per reason from the first scrape.
+KV_FALLBACK_REASONS = ("disabled", "absent", "version", "dtype",
+                       "page_size", "geometry", "capacity", "error")
+
 
 class Histogram:
     def __init__(self, buckets: tuple[float, ...]):
@@ -163,6 +173,22 @@ class EngineMetrics:
         # via the gateway's replay path).
         self.drain_state = 0
         self.drain_parked_total = 0
+        # KV page shipping (docs/kv-cache.md, docs/disaggregation.md):
+        # exports serialized for transport (count/bytes/seconds), restores
+        # landed H2D with zero prefill dispatches, and the reason-labeled
+        # replay fallbacks — without the reason label, replay and transfer
+        # are indistinguishable in /metrics. The label set is closed (code
+        # picks from KV_FALLBACK_REASONS), so cardinality is bounded and
+        # every series renders from scrape one. The offload-tier gauges
+        # scrape live from the tier's info() block at render time.
+        self.kv_ship_total = 0
+        self.kv_ship_bytes_total = 0
+        self.kv_ship_seconds_total = 0.0
+        self.kv_restored_total = 0
+        self.kv_restored_bytes_total = 0
+        self.kv_ship_fallback_total: dict[str, int] = {
+            r: 0 for r in KV_FALLBACK_REASONS
+        }
         # Multi-LoRA serving (llmlb_tpu/lora, docs/lora.md): adapter
         # hot-loads/evictions (their RATE is the thrash signal the
         # EngineLoraThrash alert pages on), disk→device load latency, and a
@@ -344,6 +370,29 @@ class EngineMetrics:
         with self._lock:
             self.drain_state = int(state)
 
+    def record_kv_ship(self, nbytes: int, seconds: float) -> None:
+        """One KV page payload serialized D2H for transport (handoff
+        export, resume export, or an offload-tier spill)."""
+        with self._lock:
+            self.kv_ship_total += 1
+            self.kv_ship_bytes_total += max(0, int(nbytes))
+            self.kv_ship_seconds_total += max(0.0, float(seconds))
+
+    def record_kv_restore(self, nbytes: int) -> None:
+        """One serialized payload landed H2D into the page pool — a state
+        movement that dispatched zero prefill work."""
+        with self._lock:
+            self.kv_restored_total += 1
+            self.kv_restored_bytes_total += max(0, int(nbytes))
+
+    def record_kv_ship_fallback(self, reason: str) -> None:
+        """A movement path replayed instead of transferring pages. Unknown
+        reasons fold into "error" so the label set stays closed."""
+        with self._lock:
+            if reason not in self.kv_ship_fallback_total:
+                reason = "error"
+            self.kv_ship_fallback_total[reason] += 1
+
     def record_drain_park(self) -> None:
         with self._lock:
             self.drain_parked_total += 1
@@ -395,6 +444,10 @@ class EngineMetrics:
                 "handoff_latency_p50_s": self.handoff_latency.percentile(50),
                 "drain_state": self.drain_state,
                 "drain_parked_total": self.drain_parked_total,
+                "kv_ship_total": self.kv_ship_total,
+                "kv_ship_bytes_total": self.kv_ship_bytes_total,
+                "kv_restored_total": self.kv_restored_total,
+                "kv_ship_fallback_total": dict(self.kv_ship_fallback_total),
                 "lora_loads_total": self.lora_loads_total,
                 "lora_evictions_total": self.lora_evictions_total,
             }
@@ -407,7 +460,8 @@ class EngineMetrics:
                quant: dict | None = None,
                sched: dict | None = None,
                lora: dict | None = None,
-               flightrec: dict | None = None) -> str:
+               flightrec: dict | None = None,
+               kv_offload: dict | None = None) -> str:
         """Prometheus text exposition format. `prefix_cache` is the
         scheduler's prefix_cache_info() block (pinned-state gauges live
         there; the event counters live here); `kv_cache` is its
@@ -508,7 +562,54 @@ class EngineMetrics:
                 f"llmlb_engine_drain_state {self.drain_state}",
                 "# TYPE llmlb_engine_drain_parked_total counter",
                 f"llmlb_engine_drain_parked_total {self.drain_parked_total}",
+                "# TYPE llmlb_engine_kv_ship_total counter",
+                f"llmlb_engine_kv_ship_total {self.kv_ship_total}",
+                "# TYPE llmlb_engine_kv_ship_bytes_total counter",
+                f"llmlb_engine_kv_ship_bytes_total {self.kv_ship_bytes_total}",
+                "# TYPE llmlb_engine_kv_ship_seconds_total counter",
+                "llmlb_engine_kv_ship_seconds_total "
+                f"{self.kv_ship_seconds_total}",
+                "# TYPE llmlb_engine_kv_restored_total counter",
+                f"llmlb_engine_kv_restored_total {self.kv_restored_total}",
+                "# TYPE llmlb_engine_kv_restored_bytes_total counter",
+                "llmlb_engine_kv_restored_bytes_total "
+                f"{self.kv_restored_bytes_total}",
+                "# TYPE llmlb_engine_kv_ship_fallback_total counter",
             ]
+            for reason in KV_FALLBACK_REASONS:
+                lines.append(
+                    f'llmlb_engine_kv_ship_fallback_total{{reason="{reason}"}}'
+                    f" {self.kv_ship_fallback_total[reason]}"
+                )
+            if kv_offload is not None and kv_offload.get("enabled"):
+                lines += [
+                    "# TYPE llmlb_engine_kv_offload_budget_bytes gauge",
+                    "llmlb_engine_kv_offload_budget_bytes "
+                    f"{kv_offload.get('budget_bytes', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_bytes gauge",
+                    f"llmlb_engine_kv_offload_bytes {kv_offload.get('bytes', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_entries gauge",
+                    "llmlb_engine_kv_offload_entries "
+                    f"{kv_offload.get('entries', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_hits_total counter",
+                    f"llmlb_engine_kv_offload_hits_total {kv_offload.get('hits', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_misses_total counter",
+                    "llmlb_engine_kv_offload_misses_total "
+                    f"{kv_offload.get('misses', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_spills_total counter",
+                    "llmlb_engine_kv_offload_spills_total "
+                    f"{kv_offload.get('spills', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_evictions_total counter",
+                    "llmlb_engine_kv_offload_evictions_total "
+                    f"{kv_offload.get('evictions', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_spilled_bytes_total counter",
+                    "llmlb_engine_kv_offload_spilled_bytes_total "
+                    f"{kv_offload.get('spilled_bytes', 0)}",
+                    "# TYPE llmlb_engine_kv_offload_restored_bytes_total "
+                    "counter",
+                    "llmlb_engine_kv_offload_restored_bytes_total "
+                    f"{kv_offload.get('restored_bytes', 0)}",
+                ]
             if sched is not None:
                 lines.append(
                     "# TYPE llmlb_engine_queue_depth_class gauge"

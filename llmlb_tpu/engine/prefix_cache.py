@@ -53,6 +53,9 @@ class PrefixEntry:
     # key in the cache's entry dict (the slot for dense entries, a unique
     # negative id for paged ones — freed slots recycle their ids, pages don't)
     key: int = dataclasses.field(default=0, repr=False)
+    # namespace the entry was inserted under (LoRA adapter or None) — the
+    # host-RAM offload tier re-keys spilled entries by (ns, tokens)
+    ns: object = dataclasses.field(default=None, repr=False)
 
     @property
     def length(self) -> int:
@@ -267,7 +270,8 @@ class PrefixCache:
             key = self._next_paged_key
             self._next_paged_key -= 1
         entry = PrefixEntry(tokens=tokens, slot=slot, pages=pages,
-                            last_used=self._tick(), node=node, key=key)
+                            last_used=self._tick(), node=node, key=key,
+                            ns=ns)
         node.entry = entry
         self._by_slot[key] = entry
         self._cached_tokens += entry.length

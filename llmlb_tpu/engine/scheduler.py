@@ -48,6 +48,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from llmlb_tpu.engine.kv_offload import KVOffloadTier
+from llmlb_tpu.engine.kv_transfer import (
+    KV_WIRE_VERSION, KVPages, KVWireHeader, kv_compat_reason,
+    serialize_kv_pages,
+)
 from llmlb_tpu.engine.metrics import EngineMetrics
 from llmlb_tpu.engine.paging import PagePool
 from llmlb_tpu.engine.prefix_cache import PrefixCache, PrefixEntry
@@ -148,6 +153,26 @@ def _scatter_kv_row_paged(cache_k, cache_v, k_all, v_all, table_row):
         return pool.at[:, page, off].set(kv.astype(pool.dtype))
 
     return scatter(cache_k, k_all), scatter(cache_v, v_all)
+
+
+@partial(jax.jit, donate_argnames=("cache_k", "cache_v"))
+def _write_kv_pages(cache_k, cache_v, k_new, v_new, page_idx):
+    """Land shipped/offloaded KV pages [L, P', PS, K, D] into pool pages
+    `page_idx` [P'] — the H2D half of the page-transfer path (kv_transfer).
+    Quantized pools take pre-quantized {"q","s"} pairs verbatim: the bytes
+    on the wire are bit-exact donor pool cells, so no re-quantization (and
+    no numerics drift) happens on the way in. Callers pad `page_idx` (and
+    the sections) to the next power of two by repeating the last page —
+    duplicate scatter of identical data — so the jit cache stays at
+    log2(pool) variants."""
+
+    def scatter(pool, new):
+        if isinstance(pool, dict):
+            return {"q": pool["q"].at[:, page_idx].set(new["q"]),
+                    "s": pool["s"].at[:, page_idx].set(new["s"])}
+        return pool.at[:, page_idx].set(new.astype(pool.dtype))
+
+    return scatter(cache_k, k_new), scatter(cache_v, v_new)
 
 
 @partial(jax.jit, donate_argnames=("cache_k", "cache_v"),
@@ -277,6 +302,18 @@ class Request:
     # generation cursor instead of starting over. Host-local — never crosses
     # the plan wire (every host parks/resumes its own mirror identically).
     parked: ParkedState | None = None
+    # KV page shipping (engine/kv_transfer.py, docs/kv-cache.md). export_kv
+    # asks _emit's finish path to serialize this request's KV pages D2H
+    # before they are freed (set by the handoff-prefill path); the payload
+    # lands in kv_export for the caller. kv_restore carries a parsed
+    # inbound payload (wire or offload tier) that _insert_restored lands
+    # H2D, activating the slot with zero prefill dispatches; cleared on
+    # first use whether or not the restore succeeds (one-shot — a failed
+    # restore falls back to chunk-prefill replay). All three are host-local
+    # and never cross the plan wire.
+    export_kv: bool = False
+    kv_export: dict | None = None
+    kv_restore: "KVPages | None" = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -364,6 +401,8 @@ class EngineCore:
         kv_layout: str | None = None,
         kv_page_size: int | None = None,
         kv_pages: int | None = None,
+        kv_ship: bool | None = None,
+        kv_offload_bytes: int | None = None,
         spec_decode: bool | None = None,
         spec_max_draft: int | None = None,
         spec_ngram: int | None = None,
@@ -865,6 +904,50 @@ class EngineCore:
         # gateway's /api/traces/{id}?view=timeline. LLMLB_FLIGHTREC=0
         # disables it (emit() returns before its first clock read).
         self.flightrec = FlightRecorder()
+        # KV page shipping (engine/kv_transfer.py, docs/kv-cache.md): move
+        # serialized pages instead of chunk-prefill replay on handoff and
+        # resume. ON by default but inert until a peer actually offers or
+        # requests a payload; requires the paged layout (dense has no page
+        # identity to ship) and a single-host combined loop — split mode
+        # moves pages in-process by block-table exchange already, and a
+        # multihost restore would desync followers whose plan wire carries
+        # no page bytes. LLMLB_KV_SHIP=0 restores today's replay-only
+        # behavior bit for bit (tier-1 pinned).
+        if kv_ship is None:
+            kv_ship = os.environ.get(
+                "LLMLB_KV_SHIP", "1"
+            ).lower() not in ("0", "false", "off", "no")
+        self.kv_ship = (bool(kv_ship) and self.page_pool is not None
+                        and self.coordinator is None
+                        and self.role != "split")
+        # Serialized exports captured at drain-park time, keyed by gateway
+        # request id, served via POST /v1/kv/export so the gateway can move
+        # a mid-stream request's KV to the adopting engine instead of
+        # replaying. Bounded by num_slots per drain; entries are consumed on
+        # fetch and dropped wholesale on shutdown.
+        self._kv_exports: dict[str, dict] = {}
+        # Tiered host-RAM offload (engine/kv_offload.py): cold prefix-cache
+        # evictions and parked-slot pages spill D2H into a bounded LRU tier
+        # and restore H2D on re-hit/resume. Default 0 = off — no spill, no
+        # restore, no behavior change (tier-1 pinned).
+        if kv_offload_bytes is None:
+            try:
+                kv_offload_bytes = int(os.environ.get(
+                    "LLMLB_KV_OFFLOAD_BYTES", "0") or 0)
+            except ValueError:
+                log.warning("LLMLB_KV_OFFLOAD_BYTES is not an integer; "
+                            "offload disabled")
+                kv_offload_bytes = 0
+        self.kv_offload: KVOffloadTier | None = (
+            KVOffloadTier(kv_offload_bytes)
+            if (kv_offload_bytes and kv_offload_bytes > 0
+                and self.page_pool is not None and self.coordinator is None
+                and self.role != "split")
+            else None
+        )
+        if self.kv_offload is not None:
+            log.info("KV offload tier: %.1f MiB host-RAM budget",
+                     self.kv_offload.budget_bytes / 2**20)
         # plan/insert time accrued since the last dispatched step; the next
         # step record absorbs it (admission happens between dispatches)
         self._pending_plan_s = 0.0
@@ -1570,6 +1653,10 @@ class EngineCore:
             drafter=slot.drafter,
             spec_k=slot.spec_k,
         )
+        # KV leaves the device BEFORE the pool reclaims it: a draining
+        # engine records the wire payload for /v1/kv/export, the offload
+        # tier keeps it for a local restore (docs/kv-cache.md)
+        self._spill_parked_slot(slot_id, request, reason)
         self._release_cache_entry(slot)
         self._free_slot_kv(slot_id)
         if slot.constraint is not None:
@@ -1821,6 +1908,337 @@ class EngineCore:
             kept.append(i)
         return kept
 
+    # ------------------------------------------------------------ kv transfer
+
+    def _kv_dtype_name(self) -> str:
+        return "int8" if self.quant.kv else str(jnp.dtype(self.cfg.dtype))
+
+    def _kv_header(self, tokens: int, num_pages: int) -> KVWireHeader:
+        return KVWireHeader(
+            version=KV_WIRE_VERSION,
+            layers=self.cfg.num_layers,
+            page_size=self.kv_page_size,
+            num_kv_heads=self.cfg.num_kv_heads,
+            head_dim=self.cfg.head_dim_,
+            kv_dtype=self._kv_dtype_name(),
+            tokens=tokens,
+            num_pages=num_pages,
+        )
+
+    def kv_restore_reason(self, header: KVWireHeader) -> str | None:
+        """None when an inbound payload can land in THIS pool verbatim,
+        else the fallback-counter reason (dtype | page_size | geometry)."""
+        return kv_compat_reason(
+            header,
+            layers=self.cfg.num_layers,
+            page_size=self.kv_page_size,
+            num_kv_heads=self.cfg.num_kv_heads,
+            head_dim=self.cfg.head_dim_,
+            kv_dtype=self._kv_dtype_name(),
+        )
+
+    def _gather_kv_sections(self, pages: list[int]) -> dict[str, np.ndarray]:
+        """D2H gather of the named pool pages into wire-section arrays
+        [L, P', PS, K, D] (int8 pools gather {codes, scales} per cache).
+        A plain read — the pool is untouched, so gathering before a free
+        is always safe."""
+        idx = jnp.asarray(pages, jnp.int32)
+        sections: dict[str, np.ndarray] = {}
+        if self.quant.kv:
+            sections["k_q"] = np.asarray(self.cache_k["q"][:, idx])
+            sections["k_s"] = np.asarray(self.cache_k["s"][:, idx])
+            sections["v_q"] = np.asarray(self.cache_v["q"][:, idx])
+            sections["v_s"] = np.asarray(self.cache_v["s"][:, idx])
+        else:
+            sections["k"] = np.asarray(self.cache_k[:, idx])
+            sections["v"] = np.asarray(self.cache_v[:, idx])
+        return sections
+
+    def _capture_kv(self, pages: list[int], tokens: int) -> KVPages:
+        return KVPages(header=self._kv_header(tokens, len(pages)),
+                       sections=self._gather_kv_sections(pages))
+
+    def _kv_export_payload(self, slot_id: int,
+                           request: Request) -> dict | None:
+        """Serialize the pages covering this slot's valid KV rows into a
+        wire payload (the /v1/handoff pages attachment). None when there is
+        nothing shippable."""
+        tokens = int(self._seq_lens[slot_id])
+        if tokens <= 0 or not self._slot_pages[slot_id]:
+            return None
+        pages = self._slot_pages[slot_id][: self._pages_for_tokens(tokens)]
+        t0 = time.monotonic()
+        kvp = self._capture_kv(pages, tokens)
+        payload = serialize_kv_pages(kvp.header, kvp.sections)
+        dt = time.monotonic() - t0
+        self.metrics.record_kv_ship(kvp.nbytes, dt)
+        self._fr_emit(request, "kv_shipped", tokens=tokens,
+                      pages=len(pages), bytes=kvp.nbytes,
+                      seconds=round(dt, 6))
+        return payload
+
+    def take_kv_export(self, gateway_id: str) -> dict | None:
+        """Consume a drain-park export (POST /v1/kv/export): the gateway
+        fetches the parked stream's serialized pages from the draining
+        origin and attaches them to /v1/resume on the adopter. One-shot —
+        the payload is handed over exactly once."""
+        with self._lock:
+            return self._kv_exports.pop(gateway_id, None)
+
+    def _land_kv_pages(self, kvp: KVPages, fresh: list[int]) -> None:
+        """H2D: land the first len(fresh) shipped pages into pool pages
+        `fresh` via the donated scatter. The page-index vector (and the
+        sections) pad to the next power of two by repeating the last page —
+        a duplicate scatter of identical bytes — so the jit cache stays at
+        log2(pool) variants, the same discipline as _copy_kv_prefix's
+        static rows."""
+        n = len(fresh)
+        pad = 1
+        while pad < n:
+            pad *= 2
+
+        def padded(name: str) -> jnp.ndarray:
+            a = kvp.sections[name][:, :n]
+            if pad > n:
+                a = np.concatenate(
+                    [a, np.repeat(a[:, -1:], pad - n, axis=1)], axis=1
+                )
+            return jnp.asarray(a)
+
+        def side(prefix: str):
+            if self.quant.kv:
+                return {"q": padded(prefix + "_q"),
+                        "s": padded(prefix + "_s")}
+            return padded(prefix)
+
+        idx = np.asarray(fresh + [fresh[-1]] * (pad - n), np.int32)
+        self.cache_k, self.cache_v = _write_kv_pages(
+            self.cache_k, self.cache_v, side("k"), side("v"),
+            jnp.asarray(idx),
+        )
+
+    def _insert_restored(self, slot_id: int, request: Request,
+                         prompt: list[int], n: int) -> bool:
+        """Page-transfer activation (docs/kv-cache.md): land a shipped KV
+        payload into freshly reserved pool pages and enter decode directly
+        — ZERO prefill dispatches. The device row restores to
+        seq_len = n-1 with committed[-1] pending: the next ordinary decode
+        dispatch rewrites position n-1's KV (identical bytes — that row
+        shipped too) and samples with the pre-increment fold n-1, exactly
+        the dispatch the uninterrupted stream ran at this position, so
+        greedy and seeded continuations stay token-identical on bf16 and
+        int8 pools alike. Any refusal drops the payload, counts a
+        reason-labeled fallback, and returns False — the caller continues
+        into the chunk-prefill replay path; a bad payload never fails the
+        request."""
+        kvp = request.kv_restore
+        request.kv_restore = None  # one-shot either way
+        st = request.parked
+        need_tokens = n - 1
+        if (kvp is None or st is None or not st.tokens or need_tokens < 1
+                or kvp.header.tokens < need_tokens):
+            self.metrics.record_kv_ship_fallback("capacity")
+            return False
+        need_pages = self._pages_for_tokens(need_tokens)
+        if need_pages > kvp.header.num_pages:
+            self.metrics.record_kv_ship_fallback("capacity")
+            return False
+        fresh = self._try_reserve_pages(need_pages)
+        while fresh is None and self._preempt_for_pages(
+                self._priority_of(request)):
+            fresh = self._try_reserve_pages(need_pages)
+        if fresh is None:
+            self.metrics.record_kv_ship_fallback("capacity")
+            return False
+        t0 = time.monotonic()
+        self._land_kv_pages(kvp, fresh)
+        self._assign_slot_pages(slot_id, (), fresh)
+
+        slot = self.slots[slot_id]
+        slot.request = request
+        # parked cursors first: _attach_constraint/_attach_spec read
+        # request.parked for the FSM cursor (already advanced over the
+        # committed tokens) and the drafter index
+        self._attach_constraint(slot_id, request)
+        s = request.sampling
+        seed = -1 if s.seed is None else (s.seed & 0x7FFFFFFF)
+        self._d_temps = self._d_temps.at[slot_id].set(float(s.temperature))
+        self._d_top_ps = self._d_top_ps.at[slot_id].set(float(s.top_p))
+        self._d_top_ks = self._d_top_ks.at[slot_id].set(int(s.top_k))
+        self._d_seeds = self._d_seeds.at[slot_id].set(seed)
+        if self.lora is not None:
+            self._d_lora_idx = self._d_lora_idx.at[slot_id].set(
+                int(self._lora_rows([request])[0])
+            )
+        self._d_seq_lens = self._d_seq_lens.at[slot_id].set(need_tokens)
+        self._d_last_tokens = self._d_last_tokens.at[slot_id].set(
+            int(prompt[-1])
+        )
+        self._seq_lens[slot_id] = need_tokens
+        slot.generated = st.generated
+        slot.out_tokens = list(st.tokens)
+        slot.prefilling = False
+        slot.prefill_pos = 0
+        slot.last_emit_at = 0.0
+        # NOT first_pending: the next decode fetch's step row IS this
+        # stream's next token (the deferred-first row is for activation
+        # samples, which never happened here)
+        slot.first_pending = False
+        request.parked = None
+        self.metrics.record_resume()
+        self.metrics.record_kv_restore(kvp.nbytes)
+        self._fr_emit(request, "kv_restored", source=kvp.source,
+                      kind="stream", tokens=need_tokens, pages=need_pages,
+                      bytes=kvp.nbytes,
+                      seconds=round(time.monotonic() - t0, 6))
+        self._fr_emit(request, "resumed", generated=st.generated,
+                      via="kv_restore")
+        log.info(
+            "kv restore: request %s re-entered decode at %d tokens from %s "
+            "(%d pages, %.1f KiB, zero prefill dispatches)",
+            request.request_id, need_tokens, kvp.source, need_pages,
+            kvp.nbytes / 1024,
+        )
+        return True
+
+    def _spill_parked_slot(self, slot_id: int, request: Request,
+                           reason: str) -> None:
+        """Park-time D2H capture with two consumers: a DRAINING engine
+        records a wire payload for the gateway's /v1/kv/export fetch (the
+        mid-stream resume then moves bytes instead of replaying), and the
+        offload tier keeps the pages host-side so a local re-activation
+        restores instead of re-prefilling. Skips first_pending parks: with
+        zero committed tokens the faithful resume is the replay path."""
+        if self.page_pool is None or not self._slot_pages[slot_id]:
+            return
+        slot = self.slots[slot_id]
+        if slot.first_pending or not slot.out_tokens:
+            return
+        tokens = int(self._seq_lens[slot_id])
+        if tokens <= 0:
+            return
+        pages = self._slot_pages[slot_id][: self._pages_for_tokens(tokens)]
+        nbytes = len(pages) * kv_page_bytes(self.cfg, self.kv_page_size,
+                                            quantized=self.quant.kv)
+        want_export = self.kv_ship and self.draining
+        tier = self.kv_offload
+        want_tier = tier is not None and tier.would_admit(nbytes)
+        if not (want_export or want_tier):
+            return
+        t0 = time.monotonic()
+        kvp = self._capture_kv(pages, tokens)
+        kvp.source = "offload"
+        self.metrics.record_kv_ship(kvp.nbytes, time.monotonic() - t0)
+        dest = []
+        if want_export:
+            payload = serialize_kv_pages(kvp.header, kvp.sections)
+            with self._lock:
+                self._kv_exports[gateway_rid(request.request_id)] = payload
+            dest.append("export")
+        if want_tier and tier.put_parked(request.request_id, kvp):
+            dest.append("offload")
+        if dest:
+            self._fr_emit(request, "kv_spilled", reason=reason,
+                          tokens=tokens, bytes=kvp.nbytes,
+                          dest=",".join(dest))
+
+    def _spill_prefix_entry(self, entry: PrefixEntry) -> None:
+        """Prefix-cache eviction under page pressure: gather the entry's
+        pages D2H into the offload tier before their references drop —
+        the cold prefix stays warm in host RAM instead of vanishing.
+        Request-anonymous, so this counts in metrics but not the flight
+        record."""
+        tier = self.kv_offload
+        if tier is None or not entry.pages:
+            return
+        nbytes = len(entry.pages) * kv_page_bytes(
+            self.cfg, self.kv_page_size, quantized=self.quant.kv
+        )
+        if not tier.would_admit(nbytes):
+            return
+        t0 = time.monotonic()
+        kvp = self._capture_kv(list(entry.pages), len(entry.tokens))
+        kvp.source = "offload"
+        self.metrics.record_kv_ship(kvp.nbytes, time.monotonic() - t0)
+        tier.put_prefix(entry.ns, entry.tokens, kvp)
+
+    def _maybe_restore_prefix(self, request: Request, n: int) -> None:
+        """Admission-time H2D promotion: if the offload tier holds a longer
+        usable prefix of this prompt than the live radix cache, land it
+        into fresh pages and re-insert it as a live entry — the ordinary
+        zero-copy match below then serves it and only the suffix prefills.
+        Failure is never fatal: pages unref'd, the cold path proceeds."""
+        tier = self.kv_offload
+        cache = self.prefix_cache
+        if tier is None or cache is None:
+            return
+        ns = request.sampling.lora
+        hit = tier.match_prefix(ns, request.prompt_ids, n - 1)
+        if hit is None:
+            return
+        stored, kvp = hit
+        # Usable head: capped at n-1 (one suffix token must prefill),
+        # aligned down to the cache grain so the re-inserted entry obeys
+        # the same alignment every live donation does. Pages are
+        # position-independent, so slicing a long stored entry is free.
+        usable = min(len(stored), n - 1)
+        usable = (usable // self.prefix_align) * self.prefix_align
+        if usable < cache.min_len:
+            return
+        tokens = tuple(stored[:usable])
+        if cache.covers(tokens, ns) or self.kv_restore_reason(
+                kvp.header) is not None:
+            # live cache already serves it, or the payload was spilled by
+            # an incompatible earlier config — drop silently (the tier
+            # popped it; bytes free up either way)
+            return
+        pages_needed = usable // self.kv_page_size
+        if pages_needed <= 0 or pages_needed > kvp.header.num_pages:
+            return
+        fresh = self._try_reserve_pages(pages_needed)
+        if fresh is None:
+            return  # pool pressure: re-prefill is the honest fallback
+        t0 = time.monotonic()
+        self._land_kv_pages(kvp, fresh)
+        for stale in cache.evict_subsumed_entries(tokens, ns):
+            self._release_entry_pages(stale)
+        if len(cache) >= cache.max_entries and not self._evict_one_prefix():
+            for p in fresh:
+                self.page_pool.unref(p)
+            return
+        if cache.insert(tokens, -1, pages=tuple(fresh), ns=ns) is None:
+            for p in fresh:
+                self.page_pool.unref(p)
+            return
+        # unlike _maybe_cache_prefix's co-ownership, the cache is the SOLE
+        # owner of these freshly alloc'd pages (refcount 1 from alloc) —
+        # no extra ref, balancing _release_entry_pages' single unref
+        self._prefix_pinned_pages += pages_needed
+        self.metrics.record_kv_restore(kvp.nbytes)
+        self.metrics.record_prefix_insert(len(tokens))
+        self._fr_emit(request, "kv_restored", source="offload",
+                      kind="prefix", tokens=len(tokens),
+                      pages=pages_needed, bytes=kvp.nbytes,
+                      seconds=round(time.monotonic() - t0, 6))
+
+    def kv_transfer_info(self) -> dict:
+        """KV movement block for /api/health and /api/system: the shipping
+        knob, transfer/fallback counters, and the host-RAM offload tier's
+        occupancy (docs/kv-cache.md)."""
+        m = self.metrics
+        return {
+            "ship_enabled": self.kv_ship,
+            "ship_total": m.kv_ship_total,
+            "ship_bytes_total": m.kv_ship_bytes_total,
+            "restored_total": m.kv_restored_total,
+            "restored_bytes_total": m.kv_restored_bytes_total,
+            "ship_fallback_total": dict(m.kv_ship_fallback_total),
+            "pending_exports": len(self._kv_exports),
+            "offload": (self.kv_offload.info()
+                        if self.kv_offload is not None
+                        else {"enabled": False}),
+        }
+
     def _try_insert(self) -> bool:
         if self.draining:
             # graceful drain: nothing new is admitted or re-activated —
@@ -1874,6 +2292,9 @@ class EngineCore:
             if request is None:
                 break
             if self._is_cancelled(request):
+                if self.kv_offload is not None:
+                    # a cancelled request's parked spill is dead bytes
+                    self.kv_offload.drop_parked(request.request_id)
                 request.events.put(("done", "cancelled"))
                 self.metrics.record_request_done("cancelled")
                 self._fr_emit(request, "finished", reason="cancelled")
@@ -1921,6 +2342,25 @@ class EngineCore:
                 self._release_lora(request)
                 handled = True
                 continue
+            # Page-transfer re-activation (docs/kv-cache.md): a parked
+            # request whose KV travelled as bytes — a /v1/resume wire
+            # payload, or a spill into the host-RAM offload tier — lands
+            # its pages and re-enters decode directly. No prefill dispatch
+            # runs and no decode-budget tokens are charged: nothing
+            # prefills. Any refusal falls through to the ordinary
+            # chunk-prefill replay below.
+            if request.parked is not None:
+                if request.kv_restore is None and self.kv_offload is not None:
+                    request.kv_restore = self.kv_offload.pop_parked(
+                        request.request_id
+                    )
+                if request.kv_restore is not None:
+                    slot_id = free.pop(0)
+                    if self._insert_restored(slot_id, request, prompt, n):
+                        handled = True
+                        inserted += 1
+                        continue
+                    free.insert(0, slot_id)
             if (budget and batch_tokens + min(n, long_cutoff) > budget
                     and (batch or inserted)):
                 # the decode budget for this iteration is spent: the request
@@ -1937,6 +2377,10 @@ class EngineCore:
             # prompt, and their own prompt head may already be donated.
             if (self.prefix_cache is not None and request.parked is None
                     and n - 1 >= self.min_prefix_len):
+                # Host-RAM tier promotion first: a spilled cold prefix lands
+                # back into fresh pages and re-enters the live cache, so the
+                # ordinary zero-copy match below serves it (docs/kv-cache.md)
+                self._maybe_restore_prefix(request, n)
                 # Longest cached prefix, capped at n-1 (at least one suffix
                 # token must prefill to produce the first sampled logits).
                 # Namespaced by adapter id (docs/lora.md): under LoRA the
@@ -2593,6 +3037,9 @@ class EngineCore:
         entry = self.prefix_cache.evict_lru_entry()
         if entry is None:
             return False  # every donor has an in-flight reader
+        # page-pressure demotion, not destruction: the cold prefix moves to
+        # the host-RAM tier (when enabled) before its pages free
+        self._spill_prefix_entry(entry)
         self._release_entry_pages(entry)
         self.metrics.record_prefix_eviction()
         return True
@@ -3534,6 +3981,14 @@ class EngineCore:
 
         if finish is not None:
             request.finished_at = time.monotonic()
+            if (finish == "length" and request.export_kv and self.kv_ship
+                    and self.page_pool is not None):
+                # Handoff export: serialize this stream's KV pages D2H
+                # BEFORE the pool frees them below — the adopter lands
+                # them and continues with zero prefill dispatches. Only
+                # the budgeted "length" finish exports: stop/cancel means
+                # the stream is over, there is nothing to move.
+                request.kv_export = self._kv_export_payload(slot_id, request)
             request.events.put(("done", finish))
             self._fr_emit(request, "finished", reason=finish,
                           generated=slot.generated)

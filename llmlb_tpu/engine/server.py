@@ -36,6 +36,11 @@ log = logging.getLogger("llmlb_tpu.engine.server")
 SYSTEM_FINGERPRINT = f"fp_llmlb_tpu_{__version__}"
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # parity: reference caps /v1/* at 20 MiB
+# Handoff/resume envelopes may carry a serialized KV page payload
+# (engine/kv_transfer.py) — base64 over tens of MiB for long contexts on
+# real configs — so the aiohttp body cap sits above the plain-JSON limit.
+# Plain chat bodies stay bounded by prompt length long before this.
+KV_BODY_BYTES = 256 * 1024 * 1024
 
 
 # The gateway forwards its trace id on proxied calls; it becomes the prefix
@@ -540,7 +545,32 @@ class EngineAPI:
             # flips the endpoint out of selection within one interval
             body["status"] = "draining"
         body["draining"] = self.drain.info()
+        # KV page shipping + host-RAM offload tier (docs/kv-cache.md)
+        body["kv_transfer"] = self.engine.core.kv_transfer_info()
         return web.json_response(body)
+
+    async def kv_export(self, request: web.Request) -> web.Response:
+        """POST /v1/kv/export {"request_id": <gateway id>} — hand over a
+        DRAINING engine's parked-stream KV pages (docs/kv-cache.md). The
+        gateway fetches this between drain-park and /v1/resume on the
+        adopter, so the mid-stream failover moves bytes instead of
+        re-prefilling. One-shot: the payload is consumed by the fetch. 404
+        when there is nothing for that id (never an error path for the
+        resume — the gateway just falls back to plain replay)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        rid = body.get("request_id") if isinstance(body, dict) else None
+        if not isinstance(rid, str) or not rid:
+            return _error(400, "'request_id' must be a non-empty string")
+        payload = self.engine.core.take_kv_export(rid)
+        if payload is None:
+            return _error(404, f"no KV export held for request {rid!r}")
+        return web.json_response(
+            {"object": "llmlb.kv_export", "request_id": rid,
+             "kv_pages": payload}
+        )
 
     async def drain_control(self, request: web.Request) -> web.Response:
         """POST /api/drain — begin a graceful drain (docs/deployment.md):
@@ -574,6 +604,7 @@ class EngineAPI:
             perf=core.perf_info(), quant=core.quant_info(),
             sched=core.sched_info(), lora=core.lora_info(),
             flightrec=core.flightrec.counters(),
+            kv_offload=core.kv_transfer_info()["offload"],
         )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
@@ -599,6 +630,8 @@ class EngineAPI:
                 "sched": self.engine.core.sched_info(),
                 # disaggregated prefill/decode: role + handoff counters
                 "disagg": self.engine.core.disagg_info(),
+                # KV page shipping + host-RAM offload tier (docs/kv-cache.md)
+                "kv_transfer": self.engine.core.kv_transfer_info(),
                 # multi-LoRA adapter pool (docs/lora.md)
                 "lora": self.engine.core.lora_info(),
                 # graceful drain state (docs/deployment.md)
@@ -1043,7 +1076,7 @@ class EngineAPI:
             return _error(400, str(e))
         rid = _request_id_from(request)
         try:
-            committed, finish = await self.engine.prefill_handoff(
+            committed, finish, kv_pages = await self.engine.prefill_handoff(
                 prompt_ids, sampling, emit_tokens=emit, request_id=rid
             )
         except EngineError as e:
@@ -1051,7 +1084,8 @@ class EngineAPI:
         except ValueError as e:
             return _error(400, str(e))
         payload = handoff_payload(
-            prompt_ids, committed, sampling, stop=stops, request_id=rid
+            prompt_ids, committed, sampling, stop=stops, request_id=rid,
+            kv_pages=kv_pages if finish is None else None,
         )
         return web.json_response(
             {
@@ -1099,9 +1133,14 @@ class EngineAPI:
             return _error(400, str(e))
         if header_deadline is not None:
             sampling.deadline_ms = header_deadline
+        # pages attachment: rides the handoff envelope itself (wire.py) —
+        # anything non-dict is treated as absent and the adoption replays
+        kv_pages = body.get("handoff", {}).get("kv_pages")
+        if not isinstance(kv_pages, dict):
+            kv_pages = None
         agen = self.engine.adopt_stream(
             prompt_ids, committed, sampling, stops,
-            request_id=rid, emitted_at=t0,
+            request_id=rid, emitted_at=t0, kv_pages=kv_pages,
         )
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -1148,9 +1187,14 @@ class EngineAPI:
         except ValueError as e:
             return _error(400, str(e))
         rid = _request_id_from(request)
+        # optional pages payload pre-fetched by the gateway from the
+        # draining origin's /v1/kv/export — lands instead of replaying
+        kv_pages = body.get("kv_pages")
+        if not isinstance(kv_pages, dict):
+            kv_pages = None
         agen = self.engine.adopt_stream(
             prompt_ids, [int(t) for t in committed], sampling, stops,
-            request_id=rid,
+            request_id=rid, kv_pages=kv_pages,
         )
         completion_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
@@ -1413,7 +1457,11 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
         post-grace abort can cut stragglers for gateway-side resume. Read
         surfaces (/api/health, /metrics) always answer — the health checker
         must be able to see the draining advertisement."""
-        if request.method == "POST" and request.path.startswith("/v1/"):
+        if (request.method == "POST" and request.path.startswith("/v1/")
+                and request.path != "/v1/kv/export"):
+            # /v1/kv/export is exempt on purpose: it exists FOR the drain
+            # window — the gateway collects parked KV pages from a draining
+            # engine before resuming the stream elsewhere
             drain = api.drain
             if drain.draining:
                 return web.json_response(
@@ -1432,13 +1480,14 @@ def create_engine_app(engine: Engine, *, owns_engine: bool = True,
                 drain.untrack(request.transport)
         return await handler(request)
 
-    app = web.Application(client_max_size=MAX_BODY_BYTES,
+    app = web.Application(client_max_size=KV_BODY_BYTES,
                           middlewares=[error_middleware, drain_middleware])
     app.router.add_get("/v1/models", api.list_models)
     app.router.add_post("/v1/chat/completions", api.chat_completions)
     app.router.add_post("/v1/handoff", api.handoff_adopt)
     app.router.add_post("/v1/handoff/prefill", api.handoff_prefill)
     app.router.add_post("/v1/resume", api.resume)
+    app.router.add_post("/v1/kv/export", api.kv_export)
     app.router.add_post("/v1/completions", api.completions)
     app.router.add_post("/v1/responses", api.responses)
     app.router.add_post("/v1/embeddings", api.embeddings)

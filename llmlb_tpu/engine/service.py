@@ -265,15 +265,18 @@ class Engine:
         sampling: SamplingParams,
         emit_tokens: int = 1,
         request_id: str | None = None,
-    ) -> tuple[list[int], str | None]:
+    ) -> tuple[list[int], str | None, dict | None]:
         """Prefill-role side of the cross-process handoff
         (docs/disaggregation.md): run admission + prefill and commit the
         first `emit_tokens` tokens, then stop. Returns ``(committed_ids,
-        finish_reason)`` — finish_reason is None when the request has more
-        to generate (the handoff case: the caller wraps the committed ids
-        in a wire payload for a decode engine to adopt), or the natural
-        finish ("stop"/"length") when the request completed inside the
-        committed window and no handoff is needed.
+        finish_reason, kv_pages)`` — finish_reason is None when the request
+        has more to generate (the handoff case: the caller wraps the
+        committed ids in a wire payload for a decode engine to adopt), or
+        the natural finish ("stop"/"length") when the request completed
+        inside the committed window and no handoff is needed. ``kv_pages``
+        is the serialized KV page payload (engine/kv_transfer.py) in the
+        handoff case when shipping is enabled, else None — the adopter
+        lands it instead of re-prefilling.
 
         Token-level on purpose: the committed ids ride the wire verbatim and
         the ADOPTING engine owns detokenization and stop sequences, so its
@@ -288,6 +291,11 @@ class Engine:
             request_id=(f"{request_id}.{uuid.uuid4().hex[:8]}"
                         if request_id else uuid.uuid4().hex),
         )
+        # ask the scheduler to serialize this stream's KV pages at the
+        # emit-budget finish, before the pool reclaims them — the payload
+        # rides the handoff envelope so the adopter can skip its replay
+        # prefill entirely (docs/kv-cache.md)
+        request.export_kv = self.core.kv_ship
         loop = asyncio.get_running_loop()
         if sampling.constraint is not None:
             request.compiled_constraint = await loop.run_in_executor(
@@ -329,8 +337,8 @@ class Engine:
             if self.core.flightrec.enabled:
                 self.core.flightrec.emit(request.request_id, "handoff_emitted",
                                          tokens=len(committed))
-            return committed, None
-        return committed, finish
+            return committed, None, request.kv_export
+        return committed, finish, None
 
     async def adopt_stream(
         self,
@@ -340,6 +348,7 @@ class Engine:
         stop: list[str] | None = None,
         request_id: str | None = None,
         emitted_at: float = 0.0,
+        kv_pages: dict | None = None,
     ) -> AsyncIterator[StreamDelta]:
         """Decode-pool side of the cross-process handoff: adopt a stream a
         prefill engine started, by replaying prompt + committed tokens as a
@@ -347,6 +356,14 @@ class Engine:
         absolute positions, so greedy and seeded-stochastic continuations
         are token-identical to an uninterrupted run) and then decoding the
         remainder here.
+
+        When ``kv_pages`` carries a serialized page payload from the origin
+        (engine/kv_transfer.py) and it is compatible with THIS pool, the
+        replay prefill is skipped entirely: the pages land H2D and the
+        stream re-enters decode directly. Any mismatch — version skew,
+        dtype, page geometry, shipping disabled here — falls back to the
+        replay path with a reason-labeled counter; a bad payload is never a
+        client-visible error.
 
         The full text (committed + continuation) is emitted: the prefill
         side never detokenized, so this engine's incremental detokenizer
@@ -442,6 +459,41 @@ class Engine:
             yield final(acc, "length")
             return
 
+        kv_restore = None
+        if kv_pages is not None:
+            if not core.kv_ship:
+                # this engine cannot land pages (knob off, dense layout,
+                # multihost, split prefill role): replay, with the reason
+                core.metrics.record_kv_ship_fallback("disabled")
+            elif not committed_ids:
+                # zero committed tokens: the faithful continuation is the
+                # activation-sample path — replay is already exact there
+                core.metrics.record_kv_ship_fallback("capacity")
+            else:
+                from llmlb_tpu.engine.kv_transfer import (
+                    KVTransferError, parse_kv_payload,
+                )
+
+                try:
+                    parsed = await loop.run_in_executor(
+                        self._executor, parse_kv_payload, kv_pages
+                    )
+                except KVTransferError as e:
+                    core.metrics.record_kv_ship_fallback(e.reason)
+                except Exception:
+                    core.metrics.record_kv_ship_fallback("error")
+                else:
+                    reason = core.kv_restore_reason(parsed.header)
+                    if reason is not None:
+                        core.metrics.record_kv_ship_fallback(reason)
+                    else:
+                        kv_restore = parsed
+        elif core.kv_ship:
+            # shipping is on but the origin sent nothing (old peer, or a
+            # killed engine whose export vanished with it): count it so an
+            # operator can see replays that SHOULD have been page moves
+            core.metrics.record_kv_ship_fallback("absent")
+
         request = Request(
             prompt_ids=list(prompt_ids), sampling=sampling,
             request_id=(f"{request_id}.{uuid.uuid4().hex[:8]}"
@@ -451,6 +503,7 @@ class Engine:
                 generated=len(committed_ids), tokens=list(committed_ids),
                 constraint=cursor, drafter=drafter, spec_k=spec_k,
             ),
+            kv_restore=kv_restore,
         )
         if sampling.lora:
             # adoption replays prompt+committed WITH the adapter — the

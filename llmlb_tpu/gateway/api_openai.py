@@ -853,6 +853,7 @@ async def proxy_openai_post(
                     prefix_hash=prefix_hash,
                     max_attempts=state.config.stream_resume_attempts,
                 )
+                replay.origin = endpoint  # kv-export source if cut here
             result = await _forward_stream(
                 request, state, upstream, endpoint, canonical, api_kind, path,
                 started, lease, prompt_text, client_ip, auth, stored_body,
@@ -1047,6 +1048,40 @@ def stream_write_guard(state: AppState, resp, endpoint,
                             stall_rules)
 
 
+async def _fetch_kv_export(state: AppState, replay: ReplayState):
+    """Collect the cut stream's serialized KV pages from its origin engine
+    (POST /v1/kv/export, docs/kv-cache.md) so the resume moves bytes
+    instead of re-prefilling. Strictly best-effort with a short clock: a
+    SIGKILL'd origin refuses the connect, an old build 404s, a finished
+    drain holds nothing — every such case returns None fast and the
+    token-identical replay path proceeds exactly as before."""
+    origin = replay.origin
+    if origin is None or not replay.rid or not replay.committed:
+        return None
+    headers = {"Content-Type": "application/json"}
+    if origin.api_key:
+        headers["Authorization"] = f"Bearer {origin.api_key}"
+    timeout = aiohttp.ClientTimeout(total=5, sock_connect=2)
+    try:
+        resp = await upstream_post(
+            state, origin, "/v1/kv/export",
+            json={"request_id": replay.rid},
+            headers=headers, timeout=timeout,
+        )
+    except Exception:
+        return None
+    try:
+        if resp.status != 200:
+            return None
+        body = await resp.json()
+    except Exception:
+        return None
+    finally:
+        resp.release()
+    pages = body.get("kv_pages") if isinstance(body, dict) else None
+    return pages if isinstance(pages, dict) else None
+
+
 async def _acquire_resume(
     state: AppState, fo: FailoverController, replay: ReplayState, model: str,
     trace=None,
@@ -1063,6 +1098,9 @@ async def _acquire_resume(
     timeout = aiohttp.ClientTimeout(
         total=state.config.inference_timeout_s, sock_connect=10
     )
+    # one-shot pickup from the (possibly draining) origin; the payload is
+    # reused across resume-attempt retries — the origin no longer holds it
+    kv_pages = await _fetch_kv_export(state, replay)
     while True:
         if replay.attempts >= replay.max_attempts:
             state.metrics.record_stream_resume("exhausted")
@@ -1111,7 +1149,7 @@ async def _acquire_resume(
         try:
             resumed = await upstream_post(
                 state, endpoint, "/v1/resume",
-                json=replay.resume_body(engine_model),
+                json=replay.resume_body(engine_model, kv_pages=kv_pages),
                 headers=headers, timeout=timeout,
             )
         except RETRYABLE_EXCEPTIONS as e:
@@ -1144,6 +1182,7 @@ async def _acquire_resume(
             fo.record_failure(endpoint, lease, "stream_pre_byte")
             continue
         lease.complete()  # stream accepted; active slot released, as ever
+        replay.origin = endpoint  # a second cut asks THIS engine for pages
         replay.resumes += 1
         state.metrics.record_stream_resume("success")
         state.metrics.record_stream_resumed_tokens(model,

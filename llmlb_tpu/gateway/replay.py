@@ -126,6 +126,11 @@ class ReplayState:
         # chunks are re-stamped with these so the splice is seamless
         self.completion_id: str | None = None
         self.created: int | None = None
+        # the endpoint currently serving this stream: a resume first asks
+        # it (POST /v1/kv/export) for the parked stream's serialized KV
+        # pages so the adopter can land bytes instead of replaying — a
+        # dead origin just fails the fetch fast and replay proceeds
+        self.origin = None
 
     # ------------------------------------------------------------- accounting
 
@@ -175,13 +180,20 @@ class ReplayState:
         character the client has seen."""
         self._ledger_stale = True
 
-    def resume_body(self, engine_model: str | None) -> dict:
+    def resume_body(self, engine_model: str | None,
+                    kv_pages: dict | None = None) -> dict:
         body = dict(self.payload)
         if engine_model:
             body["model"] = engine_model
         body["committed_ids"] = list(self.committed)
         body["stream"] = True
         body["llmlb_replay"] = True
+        if kv_pages is not None:
+            # serialized KV pages fetched from the draining origin's
+            # /v1/kv/export: the adopter lands them instead of replaying
+            # the prefill (engine/kv_transfer.py); incompatible payloads
+            # fall back engine-side, never here
+            body["kv_pages"] = kv_pages
         return body
 
 

@@ -78,6 +78,10 @@ def engine_metric_names() -> set[str]:
         flightrec={"enabled": True, "events_total": 0,
                    "events_dropped_total": 0, "requests_tracked": 0,
                    "queue_seconds_total": 0.0, "service_seconds_total": 0.0},
+        kv_offload={"enabled": True, "budget_bytes": 0, "bytes": 0,
+                    "entries": 0, "prefix_entries": 0, "parked_entries": 0,
+                    "hits": 0, "misses": 0, "spills": 0, "evictions": 0,
+                    "spilled_bytes": 0, "restored_bytes": 0},
     )
     return set(_TYPE_RE.findall(text))
 

@@ -207,3 +207,54 @@ def test_handoff_flight_records_pair_across_processes(rig):
     assert emitted[0]["request_id"] == adopted[0]["request_id"] == rid
     assert emitted[0]["ts"] <= adopted[0]["ts"]
     assert adopted[0]["attrs"]["committed"] >= 1
+
+
+def test_adoption_ships_kv_pages_and_skips_the_replay_prefill(rig):
+    """The PR 17 tentpole, cross-process: the prefill engine attaches its
+    serialized KV pages to the envelope, the adopter lands them H2D and
+    enters decode with ZERO prefill dispatches — the dispatch ledger and
+    both engines' kv counters prove the pages moved, and the joined
+    timeline shows kv_shipped -> kv_restored with no prefill_chunk on the
+    adopter (docs/kv-cache.md)."""
+    loop, cp, cd, pre, dec = rig
+    rid = "trace-xproc-kvship-1"
+    shipped0 = pre.core.metrics.kv_ship_total
+    restored0 = dec.core.metrics.kv_restored_total
+    fallbacks0 = dict(dec.core.metrics.kv_ship_fallback_total)
+
+    async def run():
+        body = {"messages": [{"role": "user",
+                              "content": "tell me about page tables"}],
+                "temperature": 0, "max_tokens": 24}
+        ref = await _reference(cp, body)
+        r = await cp.post("/v1/handoff/prefill",
+                          json={**body, "handoff_tokens": 3},
+                          headers={"X-Request-Id": rid})
+        assert r.status == 200, await r.text()
+        env = await r.json()
+        # the page payload rides INSIDE the handoff block — an old adopter
+        # ignores the unknown top-level key and replays as before
+        assert "kv_pages" in env["handoff"]
+        disp0 = sum(dec.core.prefill_dispatch_by_loop.values())
+        r = await cd.post("/v1/handoff", json={
+            "handoff": env["handoff"], "stream": False,
+            "tool_name": env.get("tool_name"),
+        })
+        assert r.status == 200, await r.text()
+        adopted = await r.json()
+        disp = sum(dec.core.prefill_dispatch_by_loop.values()) - disp0
+        assert _content(adopted) == _content(ref)
+        assert disp == 0, f"adoption ran {disp} replay prefill dispatches"
+        r = await cd.get(f"/api/requests/{rid}/timeline")
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    adopt_tl = loop.run_until_complete(run())
+    assert pre.core.metrics.kv_ship_total == shipped0 + 1
+    assert dec.core.metrics.kv_restored_total == restored0 + 1
+    assert dict(dec.core.metrics.kv_ship_fallback_total) == fallbacks0
+    events = [e["event"] for e in adopt_tl["events"]]
+    assert "kv_restored" in events
+    assert "prefill_chunk" not in events, (
+        "the adopter replay-prefilled despite landing shipped pages"
+    )

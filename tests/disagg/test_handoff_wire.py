@@ -13,6 +13,7 @@ a newly declared field is covered the moment it exists.
 import dataclasses
 import json
 
+import numpy as np
 import pytest
 
 from llmlb_tpu.disagg import (
@@ -20,6 +21,14 @@ from llmlb_tpu.disagg import (
     HandoffError,
     handoff_payload,
     parse_handoff,
+)
+from llmlb_tpu.engine.kv_transfer import (
+    KV_WIRE_VERSION,
+    KVTransferError,
+    KVWireHeader,
+    expected_sections,
+    parse_kv_payload,
+    serialize_kv_pages,
 )
 from llmlb_tpu.engine.scheduler import SamplingParams
 
@@ -160,3 +169,98 @@ def test_rejects_implausible_token_counts():
     payload["committed_ids"] = list(range(4_000_001))
     with pytest.raises(HandoffError, match="implausibly"):
         parse_handoff(payload)
+
+
+# --------------------------------------------- kv page payload header
+# The `kv_pages` sibling the envelope can carry (LLMLB_KV_SHIP) has its
+# own versioned header; same discipline as the sampling block: every
+# declared field must survive the wire, unknown inbound fields refuse.
+
+
+# One distinctive value per declared header field — pairwise-distinct
+# integers so a field-swap bug cannot cancel out. A newly declared field
+# fails _kv_probe_header until a probe value (and a wire rule) exists.
+_KV_PROBES = {
+    "version": KV_WIRE_VERSION,
+    "layers": 3,
+    "page_size": 8,
+    "num_kv_heads": 5,
+    "head_dim": 4,
+    "kv_dtype": "float32",
+    "num_pages": 2,
+    "tokens": 13,  # < num_pages * page_size, not a page multiple
+}
+
+
+def _kv_probe_header() -> KVWireHeader:
+    for f in dataclasses.fields(KVWireHeader):
+        assert f.name in _KV_PROBES, (
+            f"KVWireHeader.{f.name}: add a wire-probe value (and make "
+            "sure the field survives serialize_kv_pages -> "
+            "parse_kv_payload)"
+        )
+    return KVWireHeader(**_KV_PROBES)
+
+
+def _kv_probe_sections(header: KVWireHeader) -> dict:
+    out = {}
+    for i, (name, (shape, dtype)) in enumerate(
+            sorted(expected_sections(header).items())):
+        n = int(np.prod(shape))
+        out[name] = (np.arange(n, dtype=np.float64) % 97 + i) \
+            .astype(dtype).reshape(shape)
+    return out
+
+
+def test_every_kv_header_field_survives_the_wire():
+    header = _kv_probe_header()
+    sections = _kv_probe_sections(header)
+    payload = _roundtrip(serialize_kv_pages(header, sections))
+    parsed = parse_kv_payload(payload)
+    for f in dataclasses.fields(KVWireHeader):
+        assert getattr(parsed.header, f.name) == getattr(header, f.name), (
+            f"KVWireHeader.{f.name} was lost or mangled on the kv wire"
+        )
+    for name, arr in sections.items():
+        assert np.array_equal(parsed.sections[name], arr), (
+            f"kv section {name!r} bytes changed on the wire"
+        )
+
+
+def test_kv_probe_values_are_pairwise_distinct():
+    ints = [v for v in _KV_PROBES.values() if isinstance(v, int)]
+    assert len(ints) == len(set(ints)), (
+        "kv header probe integers collide; a swapped-field bug could "
+        "round-trip undetected"
+    )
+
+
+def test_kv_header_rides_the_handoff_envelope():
+    """The payload crosses as a top-level sibling of the handoff block —
+    an old adopter ignores it (top-level unknowns are tolerated by
+    parse_handoff, unlike sampling fields) and replays as before."""
+    header = _kv_probe_header()
+    kv = serialize_kv_pages(header, _kv_probe_sections(header))
+    payload = _roundtrip(handoff_payload([1, 2], [3], SamplingParams(),
+                                         kv_pages=kv))
+    parse_handoff(payload)  # old-adopter path: kv_pages is invisible
+    parsed = parse_kv_payload(payload["kv_pages"])
+    assert parsed.header == header
+
+
+def test_kv_rejects_unknown_header_field():
+    """A newer peer's extension must version-bump, never silently drop."""
+    header = _kv_probe_header()
+    payload = serialize_kv_pages(header, _kv_probe_sections(header))
+    payload["from_the_future"] = 1
+    with pytest.raises(KVTransferError, match="from_the_future"):
+        parse_kv_payload(payload)
+
+
+def test_kv_rejects_wrong_version_with_reason():
+    header = _kv_probe_header()
+    payload = serialize_kv_pages(header, _kv_probe_sections(header))
+    payload["version"] = KV_WIRE_VERSION + 1
+    with pytest.raises(KVTransferError) as e:
+        parse_kv_payload(payload)
+    assert e.value.reason == "version"
