@@ -150,6 +150,15 @@ class EngineMetrics:
         self.spec_draft_tokens_total = 0
         self.spec_accepted_tokens_total = 0
         self.spec_emitted_tokens_total = 0
+        # Fused decode (docs/fused-decode.md): decode/verify steps served by
+        # the single-program path, total device dispatches issued by the
+        # decode loop (fused: exactly one per step — the invariant
+        # scripts/check_fused_dispatch.py pins), and constrained slots that
+        # fell back to single-step legacy decode (grammar-table budget or
+        # fused mode off).
+        self.fused_decode_steps_total = 0
+        self.decode_dispatches_total = 0
+        self.constrained_burst_fallback_total = 0
         # Overload protection (docs/scheduling.md): slots parked under
         # slot/page pressure, parked requests re-activated, and requests
         # shed at admission because their deadline had already passed.
@@ -200,6 +209,11 @@ class EngineMetrics:
         self.lora_load = Histogram(COMPILE_BUCKETS)
         self.lora_requests_total: dict[str, int] = {}
         self._LORA_LABEL_CAP = 64
+        # LoRA requests that disabled the context-parallel prefill mesh and
+        # fell back to chunked prefill (the bgmv delta is not mesh-sharded;
+        # docs/lora.md). Rate, not a one-off: sustained growth means long
+        # LoRA prompts are paying single-chip prefill latency.
+        self.lora_cp_fallback_total = 0
         # Step-phase time breakdown (engine/stepstats.py): one histogram per
         # phase of the step loop, fed once per dispatch, plus the slow-step
         # anomaly counter. Lazily keyed so only phases that occur render.
@@ -306,6 +320,21 @@ class EngineMetrics:
             self.spec_accepted_tokens_total += accepted
             self.spec_emitted_tokens_total += emitted
 
+    def record_decode_dispatches(self, n: int, fused: bool = False) -> None:
+        """Device dispatches issued by one decode-loop step (decode or
+        verify kind). `fused` marks steps served by the single-program
+        path; legacy steps report their honest multi-dispatch count."""
+        with self._lock:
+            self.decode_dispatches_total += max(0, int(n))
+            if fused:
+                self.fused_decode_steps_total += 1
+
+    def record_constrained_burst_fallback(self) -> None:
+        """A constrained slot forced the decode loop off the fused/burst
+        path into single-step legacy decode this step."""
+        with self._lock:
+            self.constrained_burst_fallback_total += 1
+
     def record_step_phases(self, phases: dict[str, float],
                            slow: bool = False) -> None:
         """One locked update per step: every phase duration plus the
@@ -353,6 +382,12 @@ class EngineMetrics:
     def record_lora_eviction(self) -> None:
         with self._lock:
             self.lora_evictions_total += 1
+
+    def record_lora_cp_fallback(self) -> None:
+        """A LoRA request's long prompt skipped the context-parallel
+        prefill mesh and took chunked prefill instead."""
+        with self._lock:
+            self.lora_cp_fallback_total += 1
 
     def record_lora_request(self, adapter: str) -> None:
         """Per-adapter request counter (docs/lora.md). Label cardinality is
@@ -436,6 +471,10 @@ class EngineMetrics:
                           / self.spec_draft_tokens_total, 4)
                     if self.spec_draft_tokens_total else None
                 ),
+                "fused_decode_steps_total": self.fused_decode_steps_total,
+                "decode_dispatches_total": self.decode_dispatches_total,
+                "constrained_burst_fallback_total":
+                    self.constrained_burst_fallback_total,
                 "preemptions_total": self.preemptions_total,
                 "preempt_resumes_total": self.preempt_resumes_total,
                 "deadline_shed_total": self.deadline_shed_total,
@@ -541,6 +580,16 @@ class EngineMetrics:
                 "# TYPE llmlb_engine_spec_emitted_tokens_total counter",
                 "llmlb_engine_spec_emitted_tokens_total "
                 f"{self.spec_emitted_tokens_total}",
+                "# TYPE llmlb_engine_fused_decode_steps_total counter",
+                "llmlb_engine_fused_decode_steps_total "
+                f"{self.fused_decode_steps_total}",
+                "# TYPE llmlb_engine_decode_dispatches_total counter",
+                "llmlb_engine_decode_dispatches_total "
+                f"{self.decode_dispatches_total}",
+                "# TYPE llmlb_engine_constrained_burst_fallback_total "
+                "counter",
+                "llmlb_engine_constrained_burst_fallback_total "
+                f"{self.constrained_burst_fallback_total}",
                 "# TYPE llmlb_engine_preemptions_total counter",
                 f"llmlb_engine_preemptions_total {self.preemptions_total}",
                 "# TYPE llmlb_engine_preempt_resumes_total counter",
@@ -652,6 +701,9 @@ class EngineMetrics:
                     "# TYPE llmlb_engine_lora_evictions_total counter",
                     "llmlb_engine_lora_evictions_total "
                     f"{self.lora_evictions_total}",
+                    "# TYPE llmlb_engine_lora_cp_fallback_total counter",
+                    "llmlb_engine_lora_cp_fallback_total "
+                    f"{self.lora_cp_fallback_total}",
                 ]
                 if self.lora_requests_total:
                     lines.append(

@@ -60,6 +60,11 @@ from llmlb_tpu.engine.flightrec import FlightRecorder, gateway_rid
 from llmlb_tpu.engine.stepstats import StepRecorder
 from llmlb_tpu.models import family_for
 from llmlb_tpu.models.llama import LlamaConfig, Params
+from llmlb_tpu.ops.grammar import (
+    GrammarTables,
+    grammar_advance,
+    grammar_bias,
+)
 from llmlb_tpu.ops.sampling import sample_tokens
 from llmlb_tpu.parallel.mesh import MeshConfig, build_mesh, default_tp
 from llmlb_tpu.quant import kv_cell_bytes, parse_quant_mode, quantize_params
@@ -67,6 +72,18 @@ from llmlb_tpu.spec import PromptLookupDrafter, SpecConfig
 from llmlb_tpu.structured.constraint import ConstraintState, TokenConstraint
 
 log = logging.getLogger("llmlb_tpu.engine")
+
+# Process-wide cache for the jit-wrapped fused/burst step builders
+# (_build_decode_many and friends). family.decode_step etc. are module-level
+# jits every engine shares, but the scan/verify wrappers are built per
+# EngineCore — without this cache each engine instance would recompile them
+# from scratch, which with fused decode on by default turns a test suite's
+# many short-lived CPU engines into a compile storm. Keyed by object
+# identity of the closed-over config/family (values keep strong refs so an
+# id() can never be recycled into an alias); grow-only for the process
+# lifetime, exactly like jit's own executable cache.
+_PROGRAM_CACHE: dict[tuple, tuple] = {}
+_PROGRAM_CACHE_LOCK = threading.Lock()
 
 # Priority classes (docs/scheduling.md): lower value = more important.
 # Dialect-facing names map high/normal/low onto 0/1/2 at the HTTP layer.
@@ -352,6 +369,12 @@ class _Slot:
     # advanced host-side on every emitted token; its bias row is this slot's
     # stripe of the [B, V] decode mask.
     constraint: ConstraintState | None = None
+    # Fused decode (ops/grammar.py): absolute row offset of this slot's
+    # schema in the device grammar table, -1 when the schema is not
+    # device-resident (fused off, or table budget exceeded — the slot then
+    # takes the legacy host-mask path). The device cursor for a step is
+    # gram_offset + constraint.state; the host FSM stays source of truth.
+    gram_offset: int = -1
     # Speculative decoding (llmlb_tpu/spec): the per-request prompt-lookup
     # index, fed every emitted token; None when this request does not
     # speculate. spec_k is the request's draft budget per verify step.
@@ -395,6 +418,7 @@ class EngineCore:
         eos_id: int = -1,
         seed: int = 0,
         decode_burst: int | None = None,
+        fused_decode: bool | None = None,
         prefix_cache: bool | None = None,
         prefix_cache_slots: int | None = None,
         min_prefix_len: int | None = None,
@@ -567,6 +591,9 @@ class EngineCore:
         if lora_dir is None:
             lora_dir = os.environ.get("LLMLB_LORA_DIR") or None
         self.lora = None
+        # one-time CP→chunked prefill fallback warning (satellite of the
+        # fused-decode PR; the counter keeps counting after the first)
+        self._lora_cp_warned = False
         if lora_dir:
             from llmlb_tpu.lora import LoraManager
 
@@ -836,6 +863,45 @@ class EngineCore:
         # compile lock dedup concurrent callers)
         self._decode_many_lock = threading.Lock()
 
+        # Fused decode (docs/fused-decode.md): serve every decode step as
+        # ONE device program — the burst scan (even at k=1) with sampling
+        # inside, grammar masking via the device-resident transition table
+        # (ops/grammar.py), and verify steps with in-program mask columns,
+        # last-token splice, and accept counting. Default auto: on for the
+        # paged layout, off for dense; LLMLB_FUSED_DECODE=0 keeps every
+        # legacy path bit for bit (tier-1 pinned).
+        if fused_decode is None:
+            env = os.environ.get("LLMLB_FUSED_DECODE", "").strip().lower()
+            if env in ("1", "true", "on", "yes"):
+                fused_decode = True
+            elif env in ("0", "false", "off", "no"):
+                fused_decode = False
+            elif env:
+                log.warning(
+                    "LLMLB_FUSED_DECODE=%r is not a boolean; using the "
+                    "auto default", env,
+                )
+        if fused_decode is None:
+            fused_decode = self.kv_layout == "paged"
+        self.fused_decode = bool(fused_decode)
+        # Device grammar tables: one concatenated [rows, V] int32 next-state
+        # array shared by every resident schema (row 0 = the free row).
+        # Allocated only when fused decode is on — legacy engines keep the
+        # host [slots, V] mask mirror below and never pay the table bytes.
+        self._grammar_tables: GrammarTables | None = (
+            GrammarTables(cfg.vocab_size) if self.fused_decode else None
+        )
+        self._grammar_warned = False
+        # Flips (one-way) when a schema cannot go device-resident: host
+        # mask rows are then maintained for every constrained slot so the
+        # legacy fallback path masks mixed batches correctly.
+        self._grammar_fallback = False
+        # Fused program caches: the grammar-masked burst scan per window
+        # (separate from _decode_many, whose int keys tier-1 pins), and the
+        # fused verify per (window, grammar?) pair.
+        self._decode_many_gram: dict[int, Callable] = {}
+        self._verify_fused: dict[tuple[int, bool], Callable] = {}
+
         # Context-window buckets (pow2, up to capacity): every decode reads
         # only the smallest bucket covering all active sequences, so
         # attention HBM traffic scales with the context in use instead of
@@ -982,6 +1048,14 @@ class EngineCore:
         self.prefill_dispatch_by_loop: dict[str, int] = {
             "main": 0, "prefill": 0, "decode": 0, "handoff": 0,
         }
+        # Decode-side companion ledger: device dispatches issued by
+        # decode/verify steps, per loop. The fused-decode invariant —
+        # exactly ONE dispatch per decode-loop step — is asserted over this
+        # dict plus the per-step `dispatches` field on stepstats records
+        # (scripts/check_fused_dispatch.py).
+        self.decode_dispatch_by_loop: dict[str, int] = {
+            "main": 0, "prefill": 0, "decode": 0, "handoff": 0,
+        }
         if self.role == "split":
             from llmlb_tpu.disagg.split import SplitRuntime
 
@@ -1062,7 +1136,10 @@ class EngineCore:
             if not self._running:
                 return
             try:
-                if self.decode_burst > 1:
+                if self.decode_burst > 1 or self.fused_decode:
+                    # fused engines dispatch the burst scan even at k == 1;
+                    # grammar/fused-verify variants compile on first use
+                    # (their tables don't exist until a schema registers)
                     self._decode_many_for(w).lower(*args).compile()
                 elif paged:
                     self.family.decode_step_paged.lower(
@@ -1453,14 +1530,18 @@ class EngineCore:
 
     def _record_step(self, kind: str, phases: dict[str, float], *,
                      active_slots: int = 0, tokens: int = 0,
-                     slots: "list[int] | None" = None) -> None:
+                     slots: "list[int] | None" = None,
+                     dispatches: int = 0, fused: bool = False) -> None:
         """Finalize one step record: absorb plan/insert time accrued since
         the previous dispatch, feed the ring buffer + anomaly detector, and
         mirror the phase durations into the Prometheus histograms. `slots`
         names the slot ids this dispatch touched: their requests' gateway
         ids land on the StepRecord (so /api/steps?slow=1 names the victims)
         and a flagged step writes a slow_step event into each victim's
-        flight record."""
+        flight record. `dispatches` is the honest device-program count this
+        step issued (decode/verify kinds feed the per-loop dispatch ledger
+        and the fused-decode "exactly one" invariant); `fused` marks steps
+        served by the single-program path."""
         if self._pending_plan_s > 0.0:
             phases["plan"] = phases.get("plan", 0.0) + self._pending_plan_s
             self._pending_plan_s = 0.0
@@ -1471,10 +1552,14 @@ class EngineCore:
                 r = self.slots[i].request
                 if r is not None:
                     request_ids[str(i)] = gateway_rid(r.request_id)
+        if kind in ("decode", "verify") and dispatches > 0:
+            self.decode_dispatch_by_loop[self._loop_tag()] += dispatches
+            self.metrics.record_decode_dispatches(dispatches, fused=fused)
         slow = self.step_stats.observe(kind, phases,
                                        active_slots=active_slots,
                                        tokens=tokens,
-                                       request_ids=request_ids)
+                                       request_ids=request_ids,
+                                       dispatches=dispatches)
         self.metrics.record_step_phases(phases, slow=slow)
         if slow and request_ids and self.flightrec.enabled:
             total = round(sum(phases.values()), 6)
@@ -2476,17 +2561,32 @@ class EngineCore:
         """Claim a slot for a prompt beyond the largest one-shot bucket.
         Returns True when it ran a heavy synchronous prefill (CP path)."""
         slot = self.slots[slot_id]
-        if self._use_cp_prefill and hasattr(
+        cp_capable = self._use_cp_prefill and hasattr(
             self.family, "make_context_parallel_prefill"
-        ) and not (self.lora is not None and request.sampling.lora):
-            # LoRA requests take the chunked path even on an sp>1 mesh: the
-            # ring-attention prefill closure carries no adapter indices (a
-            # sharded bgmv inside shard_map is future work — docs/lora.md)
+        )
+        lora_request = self.lora is not None and request.sampling.lora
+        if cp_capable and not lora_request:
             # Ring-attention prefill: one distributed pass over the mesh
             # sp axis fills the whole prompt's KV (per-chip sequence cost
             # ~n/sp), then scatters into the slot row.
             self._cp_prefill_into_slot(slot_id, request, n)
             return True
+        if cp_capable and lora_request:
+            # LoRA requests take the chunked path even on an sp>1 mesh: the
+            # ring-attention prefill closure carries no adapter indices (a
+            # sharded bgmv inside shard_map is future work — docs/lora.md).
+            # Counted + warned once: this prompt pays single-chip prefill
+            # latency, which an operator sizing the mesh should see.
+            self.metrics.record_lora_cp_fallback()
+            if not self._lora_cp_warned:
+                self._lora_cp_warned = True
+                log.warning(
+                    "LoRA request %s (adapter %r, %d prompt tokens) fell "
+                    "back from context-parallel to chunked prefill: the CP "
+                    "pass carries no adapter indices. Further fallbacks "
+                    "count on llmlb_engine_lora_cp_fallback_total.",
+                    request.request_id, request.sampling.lora, n,
+                )
         # Single-chip long prompt: chunked prefill. Claim the slot, park
         # its device seq_len at capacity-1 (batched decode's garbage
         # writes for this row land in the unused last cell), and let
@@ -2612,11 +2712,42 @@ class EngineCore:
         else:
             state = ConstraintState(request.compiled_constraint)
             self.metrics.record_structured_request()
-        self.slots[slot_id].constraint = state
+        slot = self.slots[slot_id]
+        slot.constraint = state
+        slot.gram_offset = -1
         self._constrained_count += 1
+        if self._grammar_tables is not None:
+            # Fused decode: make the schema device-resident so this slot's
+            # masks and cursor advances run in-program. Registration is
+            # per-schema (idempotent); failure = table budget exceeded —
+            # the slot then keeps the legacy host-mask path, and every
+            # already-registered slot regrows a host row so a mixed batch's
+            # legacy fallback masks ALL constrained rows.
+            off = self._grammar_tables.register(request.compiled_constraint)
+            if off is not None:
+                slot.gram_offset = off
+            elif not self._grammar_fallback:
+                self._grammar_fallback = True
+                if not self._grammar_warned:
+                    self._grammar_warned = True
+                    log.warning(
+                        "grammar table budget exceeded "
+                        "(LLMLB_GRAMMAR_TABLE_MB=%d MiB); constrained "
+                        "decoding falls back to the host-mask path",
+                        self._grammar_tables.budget_bytes >> 20,
+                    )
+                for j, s in enumerate(self.slots):
+                    if s.constraint is not None and j != slot_id:
+                        self._set_mask_row(j, s.constraint, force=True)
         self._set_mask_row(slot_id, state)
 
-    def _set_mask_row(self, slot_id: int, state: ConstraintState) -> None:
+    def _set_mask_row(self, slot_id: int, state: ConstraintState, *,
+                      force: bool = False) -> None:
+        if (not force and self.fused_decode and not self._grammar_fallback
+                and self.slots[slot_id].gram_offset >= 0):
+            # device-resident schema: the fused program derives this row
+            # from the grammar table in-program — no host mirror to keep
+            return
         if self._mask_bias is None:
             self._mask_bias = np.zeros(
                 (self.num_slots, self.cfg.vocab_size), np.float32
@@ -2626,6 +2757,7 @@ class EngineCore:
 
     def _clear_constraint(self, slot_id: int) -> None:
         slot = self.slots[slot_id]
+        slot.gram_offset = -1
         if slot.constraint is None:
             return
         slot.constraint = None
@@ -2685,13 +2817,31 @@ class EngineCore:
             max_ngram=self.spec.max_ngram, min_ngram=self.spec.min_ngram,
         )
 
+    def _fused_step_ok(self, active: list[int]) -> bool:
+        """True when this step can run as ONE fused device program: fused
+        mode on and every active constrained slot's schema device-resident
+        (a slot whose schema missed the grammar-table budget drags the
+        whole step back to the legacy multi-dispatch path — correctness
+        over dispatch count)."""
+        if not self.fused_decode:
+            return False
+        return all(
+            self.slots[i].constraint is None
+            or self.slots[i].gram_offset >= 0
+            for i in active
+        )
+
     def _collect_drafts(
-        self, active: list[int]
+        self, active: list[int], fused: bool = False
     ) -> tuple[dict[int, list[int]], dict[int, list[int]]]:
         """Per-slot draft proposals for this step (empty for slots that are
         not speculating, have no n-gram match, or no room to speculate), plus
         each constrained slot's FSM-state path along its kept drafts — the
-        lookahead that builds the per-position verify masks."""
+        lookahead that builds the per-position verify masks. With `fused`
+        the host pre-walk is skipped entirely: the fused verify program
+        derives every mask column from the device grammar table, and a
+        disallowed draft simply fails its (masked) acceptance comparison at
+        the same position the truncation would have cut."""
         drafts: dict[int, list[int]] = {}
         lookahead: dict[int, list[int]] = {}
         for i in active:
@@ -2708,7 +2858,7 @@ class EngineCore:
                 k = min(slot.spec_k, room, budget)
                 if k > 0:
                     d = slot.drafter.propose(k)
-                if d and slot.constraint is not None:
+                if d and slot.constraint is not None and not fused:
                     d, states = self._constrained_draft_prefix(
                         slot.constraint, d
                     )
@@ -2811,14 +2961,131 @@ class EngineCore:
                 self._verify_fns[window] = fn
             return fn
 
+    def _build_verify_fused(self, window: int, grammar: bool) -> Callable:
+        """Jit the FUSED verify step: everything the legacy verify path did
+        across several device programs — last-token splice into column 0,
+        per-position grammar masks (device transition-table walk instead of
+        the host FSM lookahead), the K+1-token extend, per-position
+        sampling, accept counting, and the seq-len/last-token advance —
+        compiled into ONE dispatch. Output tokens are [B, K+3]: column 0
+        echoes the input last token, columns 1..K+1 the samples, and the
+        final column the in-program accepted-prefix count per row."""
+        family, cfg, mesh = self.family, self.cfg, self.mesh
+        k1 = self.spec.max_draft_tokens + 1
+
+        def gram_mask(gram_table, gram_state, ids):
+            # Column j's mask is the grammar state after consuming drafts
+            # 1..j — the device analogue of the host pre-walk. A disallowed
+            # draft clamps (grammar_advance), replicating the last live
+            # state's row exactly like the legacy stripe padding; its
+            # sample can then never equal the draft, so acceptance stops
+            # at the same position the host truncation would have cut.
+            s = gram_state
+            biases = [grammar_bias(gram_table, s)]
+            for j in range(1, k1):
+                s = grammar_advance(gram_table, s, ids[:, j])
+                biases.append(grammar_bias(gram_table, s))
+            return jnp.stack(biases, axis=1).reshape(
+                ids.shape[0] * k1, -1
+            )
+
+        def finish(ids, toks, chunk_lens, start_pos, lens, last_tokens,
+                   active_mask):
+            # accepted = longest prefix of drafts matching the model's own
+            # samples — the same comparison the host emit loop walks
+            # (tokens[i, 1+j] == d[j]), vectorized as a cumprod
+            b = ids.shape[0]
+            cols = jnp.arange(1, k1, dtype=jnp.int32)[None, :]
+            matches = ((toks[:, :-1] == ids[:, 1:])
+                       & (cols < chunk_lens[:, None]))
+            accepted = jnp.sum(
+                jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1
+            ).astype(jnp.int32)
+            # Active rows advance by accepted + 1 (the correction/bonus
+            # sample); every other row — prefilling slots parked at
+            # capacity-1, free slots — must keep its lens/last untouched,
+            # which the host-side scatter got for free by only writing
+            # surviving rows.
+            new_lens = jnp.where(active_mask,
+                                 start_pos + accepted + 1, lens)
+            new_last = jnp.where(
+                active_mask,
+                toks[jnp.arange(b, dtype=jnp.int32), accepted],
+                last_tokens,
+            )
+            out = jnp.concatenate(
+                [ids[:, :1], toks, accepted[:, None]], axis=1
+            )
+            return out, new_last, new_lens
+
+        if self.page_pool is not None:
+            def run(params, ids, chunk_lens, start_pos, tables,
+                    cache_k, cache_v, temps, top_ps, top_ks, seeds, key,
+                    last_tokens, active_mask, lens,
+                    gram_table=None, gram_state=None, lora_idx=None):
+                ids = ids.at[:, 0].set(last_tokens)
+                mask = (gram_mask(gram_table, gram_state, ids)
+                        if grammar else None)
+                logits, cache_k, cache_v = family.verify_step_paged(
+                    params, cfg, ids, chunk_lens, start_pos, tables,
+                    cache_k, cache_v, mesh, window=window,
+                    lora_idx=lora_idx,
+                )
+                toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
+                                     seeds, mask, start_pos)
+                out, new_last, new_lens = finish(
+                    ids, toks, chunk_lens, start_pos, lens, last_tokens,
+                    active_mask,
+                )
+                return out, new_last, new_lens, cache_k, cache_v
+
+            return jax.jit(run, donate_argnums=(5, 6))
+
+        def run(params, ids, chunk_lens, start_pos,
+                cache_k, cache_v, temps, top_ps, top_ks, seeds, key,
+                last_tokens, active_mask, lens,
+                gram_table=None, gram_state=None, lora_idx=None):
+            ids = ids.at[:, 0].set(last_tokens)
+            mask = (gram_mask(gram_table, gram_state, ids)
+                    if grammar else None)
+            slot_ids = jnp.arange(ids.shape[0], dtype=jnp.int32)
+            logits, cache_k, cache_v = family.verify_step(
+                params, cfg, ids, chunk_lens, start_pos, slot_ids,
+                cache_k, cache_v, mesh, window=window, lora_idx=lora_idx,
+            )
+            toks = _sample_chunk(logits, key, temps, top_ps, top_ks,
+                                 seeds, mask, start_pos)
+            out, new_last, new_lens = finish(
+                ids, toks, chunk_lens, start_pos, lens, last_tokens,
+                active_mask,
+            )
+            return out, new_last, new_lens, cache_k, cache_v
+
+        return jax.jit(run, donate_argnums=(4, 5))
+
+    def _verify_fused_for(self, window: int, grammar: bool) -> Callable:
+        with self._decode_many_lock:
+            key = (window, grammar)
+            fn = self._verify_fused.get(key)
+            if fn is None:
+                fn = self._shared_program(
+                    "verify_fused",
+                    (self.spec.max_draft_tokens, window, grammar),
+                    lambda: self._build_verify_fused(window, grammar))
+                self._verify_fused[key] = fn
+            return fn
+
     def _verify_active(self, active: list[int], drafts: dict[int, list[int]],
                        lookahead: dict[int, list[int]],
-                       draft_s: float) -> bool:
+                       draft_s: float, fused: bool = False) -> bool:
         """One speculative verify step: dispatch every active slot's last
         token + drafts as a K+1-token chunk through the extend path, sample
         every position, accept the longest prefix of drafts matching the
         model's own samples, emit accepted + 1 tokens per slot, roll back
-        rejected-suffix state (committed length + over-allocated pages)."""
+        rejected-suffix state (committed length + over-allocated pages).
+        With `fused` the whole step is ONE device program (mask columns,
+        last-token splice, accept counts, and the lens/last advance all
+        in-program); the host emit loop is unchanged either way."""
         k1 = self.spec.max_draft_tokens + 1
         step_start = time.monotonic()
         t_sync = time.perf_counter()
@@ -2844,63 +3111,116 @@ class EngineCore:
             chunk_lens[i] = 1 + len(d)
             start_pos[i] = self._seq_lens[i]
 
-        # Per-position grammar masks: column 0 is the live cursor's row,
-        # later columns the FSM lookahead along the (pre-validated) drafts.
-        # Only rows masked this step or last (stale rows zero out) are
-        # built host-side and scattered into the persistent device buffer.
         masked = [i for i in active if self.slots[i].constraint is not None]
         mask = None
-        if masked or self._spec_masked_prev:
-            rows_upd = sorted(set(masked) | self._spec_masked_prev)
-            v = self.cfg.vocab_size
-            if self._d_spec_mask is None:
-                self._d_spec_mask = jnp.zeros((b, k1, v), jnp.float32)
-            stripes = np.zeros((len(rows_upd), k1, v), np.float32)
-            for n, i in enumerate(rows_upd):
+        dispatches = 0
+        if fused:
+            # Fused step: no host mask stripes, no persistent spec-mask
+            # buffer — the program derives every mask column from the
+            # device grammar table. Host ships only the per-row grammar
+            # cursors (offset + FSM state) and the active-row mask.
+            grammar = bool(masked)
+            gs = np.zeros((b,), np.int32)
+            act = np.zeros((b,), bool)
+            for i in active:
+                act[i] = True
                 state = self.slots[i].constraint
-                if state is None:
-                    continue  # left the masked set: the zero stripe clears it
-                stripes[n, 0] = state.bias_row()
-                states = lookahead.get(i, [state.state])
-                for j, s in enumerate(states[1:], start=1):
-                    # tc.bias_row handles dead-end states with the same
-                    # EOS-only fallback as the live cursor
-                    stripes[n, j] = state.tc.bias_row(s)
-                for j in range(max(1, len(states)), k1):
-                    stripes[n, j] = stripes[n, len(states) - 1]
-            self._d_spec_mask = self._d_spec_mask.at[
-                jnp.asarray(rows_upd, jnp.int32)
-            ].set(jnp.asarray(stripes))
-            self._spec_masked_prev = set(masked)
-        if masked:
-            mask = self._d_spec_mask.reshape(b * k1, -1)
-            self.metrics.record_masked_decode_step()
+                if state is not None:
+                    gs[i] = self.slots[i].gram_offset + state.state
+            if grammar:
+                self.metrics.record_masked_decode_step()
+        else:
+            # Per-position grammar masks: column 0 is the live cursor's
+            # row, later columns the FSM lookahead along the
+            # (pre-validated) drafts. Only rows masked this step or last
+            # (stale rows zero out) are built host-side and scattered into
+            # the persistent device buffer.
+            if masked or self._spec_masked_prev:
+                rows_upd = sorted(set(masked) | self._spec_masked_prev)
+                v = self.cfg.vocab_size
+                if self._d_spec_mask is None:
+                    self._d_spec_mask = jnp.zeros((b, k1, v), jnp.float32)
+                stripes = np.zeros((len(rows_upd), k1, v), np.float32)
+                for n, i in enumerate(rows_upd):
+                    state = self.slots[i].constraint
+                    if state is None:
+                        continue  # left the masked set: zero stripe clears
+                    stripes[n, 0] = state.bias_row()
+                    states = lookahead.get(i, [state.state])
+                    for j, s in enumerate(states[1:], start=1):
+                        # tc.bias_row handles dead-end states with the same
+                        # EOS-only fallback as the live cursor
+                        stripes[n, j] = state.tc.bias_row(s)
+                    for j in range(max(1, len(states)), k1):
+                        stripes[n, j] = stripes[n, len(states) - 1]
+                self._d_spec_mask = self._d_spec_mask.at[
+                    jnp.asarray(rows_upd, jnp.int32)
+                ].set(jnp.asarray(stripes))
+                self._spec_masked_prev = set(masked)
+                dispatches += 1  # the stripe scatter
+            if masked:
+                mask = self._d_spec_mask.reshape(b * k1, -1)
+                self.metrics.record_masked_decode_step()
         sync_s = time.perf_counter() - t_sync
 
         self._key, sk = jax.random.split(self._key)
         window = self._window_for(active, k1)
         t_dispatch = time.perf_counter()
-        # column 0 is the on-device last token per row — newly activated
-        # slots' first tokens never round-tripped through the host
-        ids_dev = jnp.asarray(ids).at[:, 0].set(self._d_last_tokens)
-        fn = self._verify_for(window)
         lora_idx = self._d_lora_idx if self.lora is not None else None
-        if self.page_pool is not None:
-            toks_dev, self.cache_k, self.cache_v = fn(
-                self.params, ids_dev, jnp.asarray(chunk_lens),
-                jnp.asarray(start_pos), self._d_block_tables,
-                self.cache_k, self.cache_v,
-                self._d_temps, self._d_top_ps, self._d_top_ks,
-                self._d_seeds, mask, sk, lora_idx=lora_idx,
-            )
+        if fused:
+            fn = self._verify_fused_for(window, grammar)
+            gram_args = ({"gram_table": self._grammar_tables.device(),
+                          "gram_state": jnp.asarray(gs)} if grammar else {})
+            # jnp.asarray is an H2D transfer, not a device program; the
+            # column-0 last-token splice happens in-program
+            if self.page_pool is not None:
+                (toks_dev, new_last, new_lens,
+                 self.cache_k, self.cache_v) = fn(
+                    self.params, jnp.asarray(ids), jnp.asarray(chunk_lens),
+                    jnp.asarray(start_pos), self._d_block_tables,
+                    self.cache_k, self.cache_v,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, sk, self._d_last_tokens,
+                    jnp.asarray(act), self._d_seq_lens,
+                    lora_idx=lora_idx, **gram_args,
+                )
+            else:
+                (toks_dev, new_last, new_lens,
+                 self.cache_k, self.cache_v) = fn(
+                    self.params, jnp.asarray(ids), jnp.asarray(chunk_lens),
+                    jnp.asarray(start_pos),
+                    self.cache_k, self.cache_v,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, sk, self._d_last_tokens,
+                    jnp.asarray(act), self._d_seq_lens,
+                    lora_idx=lora_idx, **gram_args,
+                )
+            self._d_last_tokens = new_last
+            self._d_seq_lens = new_lens
+            dispatches = 1
         else:
-            toks_dev, self.cache_k, self.cache_v = fn(
-                self.params, ids_dev, jnp.asarray(chunk_lens),
-                jnp.asarray(start_pos),
-                self.cache_k, self.cache_v,
-                self._d_temps, self._d_top_ps, self._d_top_ks,
-                self._d_seeds, mask, sk, lora_idx=lora_idx,
-            )
+            # column 0 is the on-device last token per row — newly
+            # activated slots' first tokens never round-tripped through
+            # the host
+            ids_dev = jnp.asarray(ids).at[:, 0].set(self._d_last_tokens)
+            fn = self._verify_for(window)
+            if self.page_pool is not None:
+                toks_dev, self.cache_k, self.cache_v = fn(
+                    self.params, ids_dev, jnp.asarray(chunk_lens),
+                    jnp.asarray(start_pos), self._d_block_tables,
+                    self.cache_k, self.cache_v,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, mask, sk, lora_idx=lora_idx,
+                )
+            else:
+                toks_dev, self.cache_k, self.cache_v = fn(
+                    self.params, ids_dev, jnp.asarray(chunk_lens),
+                    jnp.asarray(start_pos),
+                    self.cache_k, self.cache_v,
+                    self._d_temps, self._d_top_ps, self._d_top_ks,
+                    self._d_seeds, mask, sk, lora_idx=lora_idx,
+                )
+            dispatches += 2  # the ids splice + the verify program
         t_compute = time.perf_counter()
         jax.block_until_ready(toks_dev)
         t_fetch = time.perf_counter()
@@ -2962,7 +3282,11 @@ class EngineCore:
                 # rejected-suffix rollback: keep pages covering the
                 # committed length + the next token's write, release the rest
                 self._trim_slot_pages(i, int(self._seq_lens[i]) + 1)
-        if rows:
+        if rows and not fused:
+            # fused: the program already advanced lens/last in-program for
+            # active rows (bit-equal to these host-computed values for
+            # every surviving slot; freed slots' device rows are garbage
+            # under the same free-slot contract as the legacy skip)
             idx = jnp.asarray(rows, jnp.int32)
             self._d_seq_lens = self._d_seq_lens.at[idx].set(
                 jnp.asarray(new_lens, jnp.int32)
@@ -2970,6 +3294,7 @@ class EngineCore:
             self._d_last_tokens = self._d_last_tokens.at[idx].set(
                 jnp.asarray(new_lasts, jnp.int32)
             )
+            dispatches += 2  # the two post-emit scatters
 
         mean_span = emitted_total / max(1, len(active))
         self.metrics.record_decode_step(step_s / max(1.0, mean_span),
@@ -2988,7 +3313,7 @@ class EngineCore:
              "fetch": t_emit - t_fetch,
              "emit": time.perf_counter() - t_emit},
             active_slots=len(active), tokens=emitted_total,
-            slots=active,
+            slots=active, dispatches=dispatches, fused=fused,
         )
         return True
 
@@ -3018,7 +3343,13 @@ class EngineCore:
         (docs/lora.md)."""
         if self.lora is None:
             return {"enabled": False}
-        return self.lora.info()
+        info = self.lora.info()
+        # CP-mesh prefill fallbacks (docs/lora.md): LoRA prompts that paid
+        # single-chip chunked prefill because the ring-attention pass
+        # carries no adapter indices — surfaced here so /api/system shows
+        # the same figure the counter exports.
+        info["cp_fallback_total"] = self.metrics.lora_cp_fallback_total
+        return info
 
     def _release_cache_entry(self, slot: _Slot) -> None:
         if slot.cache_entry is not None:
@@ -3136,6 +3467,11 @@ class EngineCore:
             return {
                 "layout": "dense",
                 "kv_dtype": str(jnp.dtype(self.cfg.dtype)),
+                # what the cache ACTUALLY serves: --quantize kv on the dense
+                # layout downgrades to bf16 with only a boot-time log line,
+                # so dashboards must read the effective dtype, not the
+                # requested knob (the HBM math differs 2x)
+                "effective_kv_dtype": str(jnp.dtype(self.cfg.dtype)),
                 "num_slots": self.num_slots,
                 "slot_capacity": self.slot_capacity,
                 "hbm_bytes": kv_cache_bytes(self.cfg, self.num_slots,
@@ -3160,6 +3496,8 @@ class EngineCore:
             # planning and the Grafana KV panels)
             "kv_dtype": ("int8" if self.quant.kv
                          else str(jnp.dtype(self.cfg.dtype))),
+            "effective_kv_dtype": ("int8" if self.quant.kv
+                                   else str(jnp.dtype(self.cfg.dtype))),
             "page_size": self.kv_page_size,
             "num_slots": self.num_slots,
             "slot_capacity": self.slot_capacity,
@@ -3189,6 +3527,12 @@ class EngineCore:
             "mode": self.quant.mode,
             "weights_int8": self.quant.weights,
             "kv_int8": self.quant.kv,
+            # the dtype the KV cache actually stores, post any silent
+            # layout downgrade (--kv-layout dense + --quantize kv serves
+            # bf16): self.quant.kv is already False in that case, so this
+            # reads the same source of truth as the pool allocation
+            "effective_kv_dtype": ("int8" if self.quant.kv
+                                   else str(jnp.dtype(self.cfg.dtype))),
             "param_bytes": self.param_bytes,
             "param_bytes_bf16": self.n_params * itemsize,
             "kv_cell_bytes": kv_cell_bytes(self.cfg.head_dim_,
@@ -3694,12 +4038,108 @@ class EngineCore:
 
         return jax.jit(many, donate_argnums=(3, 4))
 
+    def _shared_program(self, kind: str, extra: tuple, build) -> Callable:
+        """Fetch/build a jit-wrapped step program through the process-wide
+        _PROGRAM_CACHE so engines sharing a config reuse one executable
+        set. The build key is everything the trace closes over (family,
+        cfg, mesh, layout, plus the caller's k/window/variant in `extra`);
+        array shapes (slots, pages, quantized-or-not pytrees) go through
+        jit's own shape-keyed cache per call, not the build key."""
+        key = (kind, id(self.family), id(self.cfg), self.mesh,
+               self.page_pool is not None) + extra
+        with _PROGRAM_CACHE_LOCK:
+            hit = _PROGRAM_CACHE.get(key)
+        if hit is None:
+            fn = build()
+            with _PROGRAM_CACHE_LOCK:
+                # racing builders converge on whichever landed first
+                hit = _PROGRAM_CACHE.setdefault(
+                    key, (fn, self.family, self.cfg))
+        return hit[0]
+
     def _decode_many_for(self, window: int) -> Callable:
         with self._decode_many_lock:
             fn = self._decode_many.get(window)
             if fn is None:
-                fn = self._build_decode_many(self.decode_burst, window)
+                fn = self._shared_program(
+                    "decode_many", (self.decode_burst, window),
+                    lambda: self._build_decode_many(self.decode_burst,
+                                                    window))
                 self._decode_many[window] = fn
+            return fn
+
+    def _build_decode_many_gram(self, k: int, window: int) -> Callable:
+        """Grammar-masked variant of the burst scan: same k-step decode
+        with, per step, the sampling bias gathered from the device grammar
+        table and the per-row cursor advanced in-program on the sampled
+        token — so constrained slots ride the burst instead of forcing the
+        batch into single-step decode. Free rows carry cursor 0 (the
+        all-zero table row): their bias is + 0.0 everywhere, bit-preserving
+        the unconstrained sampling path."""
+        family, cfg, mesh = self.family, self.cfg, self.mesh
+
+        if self.page_pool is not None:
+            def many(params, last, lens, cache_k, cache_v, tables,
+                     temps, top_ps, top_ks, seeds, key, gram_table,
+                     gram_state, lora_idx=None):
+                keys = jax.random.split(key, k)
+
+                def body(carry, step_key):
+                    last, lens, gs, ck, cv = carry
+                    logits, ck, cv = family.decode_step_paged(
+                        params, cfg, last, lens, ck, cv, tables, mesh,
+                        window=window, lora_idx=lora_idx,
+                    )
+                    bias = grammar_bias(gram_table, gs)
+                    toks = sample_tokens(logits, step_key, temps, top_ps,
+                                         top_ks, bias, seeds, lens)
+                    gs = grammar_advance(gram_table, gs, toks)
+                    return (toks, lens + 1, gs, ck, cv), toks
+
+                first_in = last  # pre-burst tokens: pending first emissions
+                (last, lens, _, cache_k, cache_v), toks = jax.lax.scan(
+                    body, (last, lens, gram_state, cache_k, cache_v), keys
+                )
+                toks = jnp.concatenate([first_in[None, :], toks], axis=0)
+                return last, lens, cache_k, cache_v, toks
+
+            return jax.jit(many, donate_argnums=(3, 4))
+
+        def many(params, last, lens, cache_k, cache_v,
+                 temps, top_ps, top_ks, seeds, key, gram_table, gram_state,
+                 lora_idx=None):
+            keys = jax.random.split(key, k)
+
+            def body(carry, step_key):
+                last, lens, gs, ck, cv = carry
+                logits, ck, cv = family.decode_step(
+                    params, cfg, last, lens, ck, cv, mesh, window=window,
+                    lora_idx=lora_idx,
+                )
+                bias = grammar_bias(gram_table, gs)
+                toks = sample_tokens(logits, step_key, temps, top_ps,
+                                     top_ks, bias, seeds, lens)
+                gs = grammar_advance(gram_table, gs, toks)
+                return (toks, lens + 1, gs, ck, cv), toks
+
+            first_in = last  # pre-burst tokens: pending first emissions
+            (last, lens, _, cache_k, cache_v), toks = jax.lax.scan(
+                body, (last, lens, gram_state, cache_k, cache_v), keys
+            )
+            toks = jnp.concatenate([first_in[None, :], toks], axis=0)
+            return last, lens, cache_k, cache_v, toks
+
+        return jax.jit(many, donate_argnums=(3, 4))
+
+    def _decode_many_gram_for(self, window: int) -> Callable:
+        with self._decode_many_lock:
+            fn = self._decode_many_gram.get(window)
+            if fn is None:
+                fn = self._shared_program(
+                    "decode_many_gram", (self.decode_burst, window),
+                    lambda: self._build_decode_many_gram(self.decode_burst,
+                                                         window))
+                self._decode_many_gram[window] = fn
             return fn
 
     def _decode_active(self) -> bool:
@@ -3729,10 +4169,12 @@ class EngineCore:
             self.slots[i].drafter is not None for i in active
         ):
             t_draft = time.perf_counter()
-            drafts, lookahead = self._collect_drafts(active)
+            fused_spec = self._fused_step_ok(active)
+            drafts, lookahead = self._collect_drafts(active, fused=fused_spec)
             draft_s = time.perf_counter() - t_draft
             if any(drafts.values()):
-                return self._verify_active(active, drafts, lookahead, draft_s)
+                return self._verify_active(active, drafts, lookahead, draft_s,
+                                           fused=fused_spec)
             # no n-gram matched: fall through to plain decode; the draft
             # time lands in this step's record below
 
@@ -3749,37 +4191,62 @@ class EngineCore:
 
         self._key, sk = jax.random.split(self._key)
         k = self.decode_burst
-        # Constrained slots advance a host-side FSM per token, so their mask
-        # cannot be updated mid-burst: any constrained slot in the batch
-        # forces single-step decode for this dispatch (the constrained-TPS
-        # cost documented in docs/structured-outputs.md). CPU engines default
-        # to burst 1 anyway; on TPU a mixed batch trades burst amortization
-        # for grammar enforcement only while constraints are in flight.
+        # Constrained slots advance a host-side FSM per token, so on the
+        # LEGACY path their mask cannot be updated mid-burst: any constrained
+        # slot in the batch forces single-step decode for this dispatch (the
+        # constrained-TPS cost documented in docs/structured-outputs.md).
+        # With fused decode the grammar lives on the device (ops/grammar) and
+        # constrained slots ride the burst scan — the fallback below only
+        # fires when a schema failed to register (budget), and is counted so
+        # the "zero single-step fallbacks" invariant is checkable.
         constrained_active = self._constrained_count > 0 and any(
             self.slots[i].constraint is not None for i in active
         )
-        if k > 1 and constrained_active:
+        fused_step = self._fused_step_ok(active)
+        if k > 1 and constrained_active and not fused_step:
             k = 1
+            self.metrics.record_constrained_burst_fallback()
         lora_idx = self._d_lora_idx if self.lora is not None else None
-        if k > 1:
+        if k > 1 or fused_step:
             burst_start = time.monotonic()
             window = self._window_for(active, k)
+            grammar = fused_step and constrained_active
+            t_mask = time.perf_counter()
+            gram_args = {}
+            if grammar:
+                # Fresh int32 cursor vector from the host FSMs (source of
+                # truth, advanced in _emit): one [SLOTS] H2D per step instead
+                # of a [SLOTS, V] float32 mask scatter. Free/parked rows sit
+                # at cursor 0 — the all-zero free row.
+                gs = np.zeros((self.num_slots,), dtype=np.int32)
+                for i in active:
+                    slot = self.slots[i]
+                    if slot.constraint is not None and slot.gram_offset >= 0:
+                        gs[i] = slot.gram_offset + slot.constraint.state
+                gram_args = {
+                    "gram_table": self._grammar_tables.device(),
+                    "gram_state": jnp.asarray(gs),
+                }
+                self.metrics.record_masked_decode_step()
+            sync_s += time.perf_counter() - t_mask
+            fn = (self._decode_many_gram_for(window) if grammar
+                  else self._decode_many_for(window))
             t_dispatch = time.perf_counter()
             if self.page_pool is not None:
                 (self._d_last_tokens, self._d_seq_lens, self.cache_k,
-                 self.cache_v, toks_dev) = self._decode_many_for(window)(
+                 self.cache_v, toks_dev) = fn(
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v, self._d_block_tables,
                     self._d_temps, self._d_top_ps, self._d_top_ks,
-                    self._d_seeds, sk, lora_idx=lora_idx,
+                    self._d_seeds, sk, lora_idx=lora_idx, **gram_args,
                 )
             else:
                 (self._d_last_tokens, self._d_seq_lens, self.cache_k,
-                 self.cache_v, toks_dev) = self._decode_many_for(window)(
+                 self.cache_v, toks_dev) = fn(
                     self.params, self._d_last_tokens, self._d_seq_lens,
                     self.cache_k, self.cache_v,
                     self._d_temps, self._d_top_ps, self._d_top_ks,
-                    self._d_seeds, sk, lora_idx=lora_idx,
+                    self._d_seeds, sk, lora_idx=lora_idx, **gram_args,
                 )
             t_compute = time.perf_counter()
             # split device execution from the D2H readback: the dispatch
@@ -3804,7 +4271,7 @@ class EngineCore:
                  "fetch": t_emit - t_fetch,
                  "emit": time.perf_counter() - t_emit},
                 active_slots=len(active), tokens=k * len(active),
-                slots=active,
+                slots=active, dispatches=1, fused=fused_step,
             )
             return True
 
@@ -3872,6 +4339,9 @@ class EngineCore:
              "emit": time.perf_counter() - t_emit},
             active_slots=len(active), tokens=len(active),
             slots=active,
+            # legacy eager step: model forward, sample, lens advance are
+            # separate dispatches, plus the mask scatter when constrained
+            dispatches=3 + (1 if mask is not None else 0), fused=False,
         )
         return True
 

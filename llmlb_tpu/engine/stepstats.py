@@ -91,13 +91,17 @@ class StepRecorder:
 
     def observe(self, kind: str, phases: dict[str, float], *,
                 active_slots: int = 0, tokens: int = 0,
-                request_ids: dict[str, str] | None = None) -> bool:
+                request_ids: dict[str, str] | None = None,
+                dispatches: int = 0) -> bool:
         """Record one step; returns True when it was flagged anomalous.
         `phases` maps phase name -> seconds (missing phases count as 0);
         `tokens` is the number of tokens this step delivered to the host
         (decode: burst x active slots); `request_ids` maps slot id ->
         gateway request id for the requests riding this dispatch, so a
-        flagged record NAMES its victims (/api/steps?slow=1)."""
+        flagged record NAMES its victims (/api/steps?slow=1); `dispatches`
+        counts the device programs this step launched (the fused-decode
+        invariant — scripts/check_fused_dispatch.py — asserts exactly 1 on
+        decode/verify records when LLMLB_FUSED_DECODE is on)."""
         now = time.time()
         total = sum(phases.values())
         with self._lock:
@@ -125,6 +129,7 @@ class StepRecorder:
                 "phases_s": {p: phases.get(p, 0.0) for p in PHASES},
                 "active_slots": active_slots,
                 "tokens": tokens,
+                "dispatches": dispatches,
                 "request_ids": dict(request_ids) if request_ids else {},
                 "slow": slow,
             })

@@ -1,0 +1,174 @@
+"""Device-resident grammar tables: constraint masking without host round-trips.
+
+The legacy structured-output path (docs/structured-outputs.md) keeps the
+grammar on the host: after every sampled token the scheduler advances a
+per-slot DFA cursor in Python, looks up the next state's float32 [V] bias
+row, and scatters it into a device mask buffer before the next dispatch.
+That host walk is why constrained slots fell out of burst decode (PR 5) and
+why speculative drafts needed a host pre-walk (PR 7) — the mask for step
+t+1 does not exist until the host has seen token t.
+
+This module moves the grammar itself onto the device. Each compiled schema's
+``TokenConstraint.transition_table()`` (int32 ``[states, V]`` → next state,
+-1 disallowed) is appended into ONE concatenated device array shared by all
+resident schemas, with per-schema row offsets. A slot's grammar cursor is
+then just an int32 (absolute row index), and both the mask and the cursor
+advance become O(1) gathers inside the fused decode/verify program:
+
+    bias[b, v]  = 0.0 where table[state[b], v] >= 0 else MASK_NEG
+    state'[b]   = table[state[b], token[b]]        (clamped to state[b]
+                                                    when the entry is -1)
+
+Row 0 of the table is the FREE row: all zeros, meaning "every token allowed,
+next state 0". Unconstrained slots carry cursor 0, get an all-zero bias
+(``logits + 0.0`` is bit-preserving), and self-loop — so one fused program
+serves mixed constrained/free batches with no branching.
+
+Memory: states × V × 4 bytes per schema (int32), uploaded once per schema —
+vs the host-mirror approach's per-step [slots, V] float32 scatter. The
+budget knob ``LLMLB_GRAMMAR_TABLE_MB`` caps total table bytes; registration
+past the budget returns None and the scheduler falls back to the legacy
+host-mask path for that schema (correctness is never budget-gated).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from llmlb_tpu.structured.constraint import MASK_NEG, TokenConstraint
+
+log = logging.getLogger("llmlb.ops.grammar")
+
+# Total device-table budget across all resident schemas. 64 MiB holds e.g.
+# 1024 DFA states over a 16k vocab (1024 x 16384 x 4B = 64 MiB) — far past
+# any schema the structured-output compiler emits today.
+_DEFAULT_BUDGET_MB = 64
+
+
+def _env_budget_bytes() -> int:
+    raw = os.environ.get("LLMLB_GRAMMAR_TABLE_MB", "")
+    try:
+        mb = float(raw) if raw else float(_DEFAULT_BUDGET_MB)
+    except ValueError:
+        mb = float(_DEFAULT_BUDGET_MB)
+    return max(1, int(mb * (1 << 20)))
+
+
+class GrammarTables:
+    """Concatenated next-state tables for every schema the engine has seen.
+
+    Grow-only by design: schemas are already LRU-capped upstream in
+    ConstraintCompiler (32 entries), so the working set is small; freeing
+    rows would invalidate live slot cursors mid-request. ``register`` is
+    idempotent per TokenConstraint instance and returns the ABSOLUTE row
+    index of that schema's DFA start-of-table (add the local DFA state to
+    get a cursor). A strong reference to each registered constraint is held
+    so a recycled ``id()`` can never alias two schemas to one offset.
+
+    Thread-safety: register() runs on the step loop and insert paths under
+    the scheduler's own locks; the internal lock only guards the host-side
+    table growth vs. ``device()`` reads from scrape threads.
+    """
+
+    def __init__(self, vocab_size: int, *, budget_bytes: int | None = None):
+        self.vocab_size = int(vocab_size)
+        self.budget_bytes = (int(budget_bytes) if budget_bytes is not None
+                             else _env_budget_bytes())
+        self._lock = threading.Lock()
+        # row 0 = the free row (see module docstring)
+        self._host = np.zeros((1, self.vocab_size), dtype=np.int32)
+        self._offsets: dict[int, int] = {}  # id(tc) -> absolute row offset
+        self._owners: list[TokenConstraint] = []  # keep ids stable
+        self._device: jnp.ndarray | None = None
+        self.schemas_registered = 0
+        self.schemas_rejected = 0
+
+    # ------------------------------------------------------------ registration
+
+    def register(self, tc: TokenConstraint) -> int | None:
+        """Absolute row offset for `tc`'s DFA state 0, or None when adding
+        the schema would exceed the table budget."""
+        with self._lock:
+            off = self._offsets.get(id(tc))
+            if off is not None:
+                return off
+            table = tc.transition_table()
+            if table.shape[1] != self.vocab_size:
+                raise ValueError(
+                    f"vocab mismatch: table {table.shape[1]} vs "
+                    f"grammar tables {self.vocab_size}"
+                )
+            new_bytes = (self._host.shape[0] + table.shape[0]) \
+                * self.vocab_size * 4
+            if new_bytes > self.budget_bytes:
+                self.schemas_rejected += 1
+                return None
+            off = self._host.shape[0]
+            # next-state entries become absolute rows into the concatenated
+            # table; -1 (disallowed) stays -1
+            shifted = np.where(table >= 0, table + off, table)
+            self._host = np.concatenate([self._host, shifted], axis=0)
+            self._offsets[id(tc)] = off
+            self._owners.append(tc)
+            self._device = None  # re-upload on next device() call
+            self.schemas_registered += 1
+            return off
+
+    # ----------------------------------------------------------------- reading
+
+    def device(self) -> jnp.ndarray:
+        """Device mirror of the concatenated table. Re-uploaded only after a
+        new schema registered (per schema, not per step)."""
+        with self._lock:
+            if self._device is None:
+                self._device = jnp.asarray(self._host)
+            return self._device
+
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return self._host.shape[0]
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return int(self._host.nbytes)
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "rows": int(self._host.shape[0]),
+                "bytes": int(self._host.nbytes),
+                "budget_bytes": self.budget_bytes,
+                "schemas": self.schemas_registered,
+                "rejected": self.schemas_rejected,
+            }
+
+
+# ------------------------------------------------------------- jittable ops
+
+
+def grammar_bias(table: jnp.ndarray, states: jnp.ndarray) -> jnp.ndarray:
+    """Additive float32 [B, V] sampling bias for the given cursors: 0 where
+    the token keeps the match alive, MASK_NEG where it kills it. Row 0
+    cursors (free slots) yield all zeros — bit-preserving under addition."""
+    rows = table[states]  # [B, V] int32 gather
+    return jnp.where(rows >= 0, jnp.float32(0.0), MASK_NEG)
+
+
+def grammar_advance(table: jnp.ndarray, states: jnp.ndarray,
+                    tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next cursors after sampling `tokens` [B] from `states` [B]. A -1
+    entry (token disallowed — only reachable for positions the mask never
+    sampled, e.g. rejected speculative draft columns) clamps to the current
+    state so lockstep cursor math stays in-table."""
+    nxt = table[states, tokens]
+    return jnp.where(nxt >= 0, nxt, states)
+
+
+__all__ = ["GrammarTables", "grammar_advance", "grammar_bias"]

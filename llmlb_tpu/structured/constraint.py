@@ -121,6 +121,49 @@ class TokenConstraint:
         # memoized: most requests only ever visit a fraction of the states.
         self._bias_rows: dict[int, np.ndarray] = {}
         self._bias_lock = threading.Lock()
+        # Dense next-state table for the device grammar path (ops/grammar).
+        # Built on first request and cached: fused decode registers each
+        # compiled schema once, not per step.
+        self._transition: np.ndarray | None = None
+
+    def transition_table(self) -> np.ndarray:
+        """Dense int32 ``[states, V]`` next-state table: ``table[s, v]`` is
+        the DFA state after sampling token v from state s, or -1 when v is
+        disallowed. Invariant: ``table[s, v] >= 0  <=>  allowed[s, v]``, so
+        a bias derived on-device from this table (0 where >= 0, MASK_NEG
+        where -1) is bit-identical to ``bias_row``. The EOS column maps an
+        accepting state to itself (EOS ends the request; the self-loop keeps
+        lockstep device cursors valid past it). Dead-end rows mirror the
+        bias_row fail-open: everything -1 except EOS self-looping."""
+        with self._bias_lock:
+            table = self._transition
+            if table is not None:
+                return table
+        states, vocab = self.allowed.shape
+        table = np.full((states, vocab), -1, dtype=np.int32)
+        trie = _token_trie(self._texts)
+        for s0 in range(states):
+            stack = [(trie, s0)]
+            row = table[s0]
+            while stack:
+                node, st = stack.pop()
+                for ch, child in node.items():
+                    if ch == "ids":
+                        row[child] = st
+                        continue
+                    nxt = self.dfa.step(st, ch)
+                    if nxt is not None:
+                        stack.append((child, nxt))
+            if self.dfa.is_accepting(s0):
+                row[self.eos_id] = s0
+            if not self.allowed[s0].any():
+                # dead-end fail-open: only EOS survives, self-looping
+                row[:] = -1
+                row[self.eos_id] = s0
+        with self._bias_lock:
+            if self._transition is None:
+                self._transition = table
+            return self._transition
 
     @property
     def num_states(self) -> int:

@@ -63,6 +63,16 @@ return, each twice on identical traffic — replay (recompute) vs ship
 (host-tier restore) — and reports resume gap, return TTFT, the
 prefill-dispatch ledger (zero dispatches per shipped resume), and
 cross-mode token identity.
+
+Fused decode dispatch (docs/fused-decode.md):
+
+    python scripts/bench_gateway.py --workload fused
+
+drives mixed traffic (plain + LoRA + JSON-constrained, speculation and
+int8 KV on) through the full gateway twice — LLMLB_FUSED_DECODE on vs
+off — and reports per-step device dispatch counts from the scheduler's
+ledger (fused holds exactly 1), decode tok/s both modes, and cross-mode
+token identity.
 """
 
 from __future__ import annotations
@@ -1107,6 +1117,175 @@ async def run_lora_bench(requests: int) -> dict:
             and batched["adapter_cache_hit_rate"]
             > naive["adapter_cache_hit_rate"]
             and batched["decode_dispatches"] < naive["decode_dispatches"]
+        ),
+    }
+
+
+async def run_fused_bench(requests: int) -> dict:
+    """Fused-decode workload (docs/fused-decode.md): mixed traffic — plain
+    chat, LoRA-adapter, JSON-schema-constrained, all with speculation and
+    int8 KV on — through the FULL gateway against a real tpu:// engine
+    (CPU backend), twice on identical engines: LLMLB_FUSED_DECODE on vs
+    off. Reports decode tok/s and, the transferable figure, the per-step
+    device dispatch count from the scheduler's ledger: fused must hold
+    exactly 1.0 per decode/verify step while legacy runs 3-5, and greedy
+    outputs must be token-identical across modes.
+
+    CPU-host honesty (the BENCH_r09 stance): XLA:CPU fuses the whole step
+    into host code either way, so dispatch overhead here is Python-sized
+    and wall-clock gains are noise; the committed evidence is structural —
+    dispatches per step and zero constrained single-step fallbacks. On
+    TPU each dispatch is a host->device launch + its H2D/D2H syncs, and
+    the per-step count IS the latency story."""
+    import tempfile
+
+    import jsonschema
+    from aiohttp.test_utils import TestServer
+
+    from llmlb_tpu.engine.presets import get_preset
+    from llmlb_tpu.engine.server import create_engine_app
+    from llmlb_tpu.engine.service import Engine
+    from llmlb_tpu.gateway.types import Capability, EndpointType
+    from llmlb_tpu.lora import save_adapter
+    from tests.support import GatewayHarness
+
+    lora_dir = tempfile.mkdtemp(prefix="bench-fused-")
+    save_adapter(lora_dir, "acme", get_preset("debug-tiny"), rank=8)
+
+    # array-of-identical-items schema: grammar + greedy decode make the
+    # constrained continuation predictable, so speculation engages on the
+    # constrained rows too (the 4-feature-on shape this PR fuses)
+    schema = {"type": "array", "items": {"enum": ["aa"]},
+              "minItems": 8, "maxItems": 8}
+    system = "You are the TPU serving assistant. Answer briefly. " * 2
+    # request plan by i % 3: plain chat, LoRA adapter, JSON-constrained
+    kinds = [("plain", "lora", "json")[i % 3] for i in range(requests)]
+
+    async def run_mode(fused: bool) -> dict:
+        engine = Engine.from_preset(
+            "debug-tiny", model_id="bench-fused", num_slots=4,
+            slot_capacity=256, prefill_buckets=(16, 32, 64), seed=0,
+            quantize="kv", lora_dir=lora_dir, spec_decode=True,
+            spec_max_draft=4, fused_decode=fused,
+        )
+        eng_server = TestServer(create_engine_app(engine,
+                                                  owns_engine=False))
+        await eng_server.start_server()
+        gw = await GatewayHarness.create()
+        try:
+            gw.register_mock(
+                f"http://127.0.0.1:{eng_server.port}", [engine.model_id],
+                endpoint_type=EndpointType.TPU,
+                capabilities=[Capability.CHAT_COMPLETION,
+                              Capability.STRUCTURED_OUTPUTS,
+                              Capability.LORA],
+            )
+            headers = dict(await gw.inference_headers())
+
+            async def one(i: int, kind: str) -> dict:
+                payload = {
+                    "model": engine.model_id,
+                    "messages": [
+                        {"role": "system", "content": system},
+                        {"role": "user",
+                         "content": f"question {i}: 1 2 3 4 5 6 7 8"},
+                    ],
+                    "max_tokens": 64, "temperature": 0.0,
+                }
+                if kind == "lora":
+                    payload["lora"] = "acme"
+                elif kind == "json":
+                    payload["response_format"] = {
+                        "type": "json_schema",
+                        "json_schema": {"name": "items", "schema": schema},
+                    }
+                resp = await gw.client.post("/v1/chat/completions",
+                                            json=payload, headers=headers)
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                text = body["choices"][0]["message"]["content"]
+                if kind == "json":
+                    jsonschema.validate(json.loads(text), schema)
+                return {"text": text,
+                        "tokens": body["usage"]["completion_tokens"]}
+
+            # XLA warmup outside the timed window, one of each shape
+            for kind in ("plain", "lora", "json"):
+                await one(-1, kind)
+
+            t0 = time.perf_counter()
+            outs = list(await asyncio.gather(*(
+                one(i, k) for i, k in enumerate(kinds)
+            )))
+            elapsed = time.perf_counter() - t0
+
+            m = engine.core.metrics
+            records = engine.core.step_stats.snapshot(limit=512)["records"]
+            decs = [r for r in records
+                    if r["kind"] in ("decode", "verify")]
+            per_step = [r["dispatches"] for r in decs] or [0]
+            completion = sum(o["tokens"] for o in outs)
+            return {
+                "fused": fused,
+                "requests": len(outs),
+                "seconds": round(elapsed, 2),
+                "completion_tokens": completion,
+                "decode_tokens_per_sec": round(completion / elapsed, 1),
+                "decode_steps_observed": len(decs),
+                "dispatches_per_step_mean": round(
+                    sum(per_step) / len(per_step), 2),
+                "dispatches_per_step_max": max(per_step),
+                "decode_dispatches_total": m.decode_dispatches_total,
+                "fused_decode_steps_total": m.fused_decode_steps_total,
+                "constrained_burst_fallbacks":
+                    m.constrained_burst_fallback_total,
+                "masked_decode_steps": m.masked_decode_steps_total,
+                "spec_verify_steps": m.spec_verify_steps_total,
+                "spec_acceptance_rate": (
+                    round(m.spec_accepted_tokens_total
+                          / m.spec_draft_tokens_total, 3)
+                    if m.spec_draft_tokens_total else None
+                ),
+                "outputs": {i: o["text"] for i, o in enumerate(outs)},
+            }
+        finally:
+            await gw.close()
+            await eng_server.close()
+            engine.shutdown()
+
+    on = await run_mode(True)
+    off = await run_mode(False)
+    identical = on["outputs"] == off["outputs"]
+    for mode in (on, off):
+        mode.pop("outputs")
+    return {
+        "metric": "fused_decode_workload",
+        "requests": requests,
+        "outputs_token_identical_across_modes": identical,
+        "dispatch_reduction_per_step": round(
+            off["dispatches_per_step_mean"]
+            / max(1e-9, on["dispatches_per_step_mean"]), 2
+        ),
+        "decode_tps_ratio": round(
+            on["decode_tokens_per_sec"]
+            / max(1e-9, off["decode_tokens_per_sec"]), 2
+        ),
+        "fused_on": on,
+        "fused_off": off,
+        "cpu_host_caveat": (
+            "wall-clock unjudgeable on a CPU backend: XLA:CPU dispatch "
+            "overhead is Python-sized, so collapsing dispatches cannot "
+            "show up in tok/s here; the transferable figures are "
+            "dispatches_per_step (fused holds exactly 1) and zero "
+            "constrained_burst_fallbacks (see docstring)"
+        ),
+        "passed": bool(
+            identical
+            and on["dispatches_per_step_max"] == 1
+            and on["constrained_burst_fallbacks"] == 0
+            and on["masked_decode_steps"] > 0
+            and on["spec_verify_steps"] > 0
+            and off["dispatches_per_step_mean"] > 1.0
         ),
     }
 
@@ -2966,7 +3145,7 @@ def main() -> None:
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
                  "structured", "spec-decode", "quantized", "throughput",
-                 "slo-mix", "disagg", "lora", "kv-ship"),
+                 "slo-mix", "disagg", "lora", "kv-ship", "fused"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
@@ -3036,6 +3215,12 @@ def main() -> None:
         return
     elif args.workload == "kv-ship":
         result = asyncio.run(run_kv_ship_bench(args.requests))
+        print(json.dumps(result))
+        if not result["passed"]:
+            sys.exit(1)
+        return
+    elif args.workload == "fused":
+        result = asyncio.run(run_fused_bench(args.requests))
         print(json.dumps(result))
         if not result["passed"]:
             sys.exit(1)

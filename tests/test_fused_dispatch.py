@@ -1,0 +1,21 @@
+"""Tier-1 guard: fused decode launches exactly ONE device program per step.
+
+Runs scripts/check_fused_dispatch.py's runtime check in-process: a CPU
+debug engine with quantized KV + LoRA + speculation + a JSON-schema
+constraint all active must record dispatches == 1 on every decode/verify
+step of its ledger under LLMLB_FUSED_DECODE=1, with zero constrained
+single-step fallbacks — the invariant the fused dispatch PR exists to
+hold (docs/fused-decode.md).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import check_fused_dispatch  # noqa: E402
+
+
+def test_fused_decode_is_one_dispatch_per_step():
+    findings = check_fused_dispatch.run_check()
+    assert not findings, "\n".join(findings)
