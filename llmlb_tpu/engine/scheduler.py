@@ -733,6 +733,11 @@ class EngineCore:
         self.draining = False
         self._drain_park_requested = False
         self._drain_flush_requested = False
+        # Park-on-demand (gateway rebalancer, docs/resilience.md): gateway
+        # request ids whose slots should park + export at the next loop
+        # iteration — the migration analogue of request_drain_park, scoped
+        # to single streams instead of the whole engine.
+        self._park_rids: set[str] = set()
         # Cancellations take effect ONLY via the plan in multihost mode: the
         # live .cancelled flag flips at arbitrary times on the leader (HTTP
         # thread), and acting on it directly would make hosts dispatch
@@ -1432,6 +1437,14 @@ class EngineCore:
         consumed by the loop thread, like Request.cancelled."""
         self._drain_park_requested = True
 
+    def request_park(self, gateway_id: str) -> None:
+        """Ask the step loop to park ONE stream (by gateway request id) at
+        its next iteration and spill its KV for export — a proactive
+        migration is pulling the stream to another engine while this one
+        keeps serving everyone else. Thread-safe the same way as
+        request_drain_park: the set is only consumed by the loop thread."""
+        self._park_rids.add(gateway_id)
+
     def request_drain_flush(self) -> None:
         """Ask the step loop to terminal-error everything still queued
         (parked-for-drain work included). Called AFTER the drain aborted
@@ -1471,6 +1484,18 @@ class EngineCore:
                 self._park_slot(i, reason="drain")
                 self.metrics.record_drain_park()
 
+    def _park_requested(self, rids: set[str]) -> None:
+        """Park the slots serving these gateway request ids (loop thread
+        only) — the per-stream migration park. Unparkable states (prefill
+        in flight, first token device-only) and ids not decoding here are
+        dropped: the gateway's export fetch times out and the migration
+        aborts with the origin stream untouched."""
+        for i, slot in enumerate(self.slots):
+            if (slot.request is not None and not slot.prefilling
+                    and not slot.first_pending and not slot.handoff_ready
+                    and gateway_rid(slot.request.request_id) in rids):
+                self._park_slot(i, reason="migrate")
+
     def _loop(self) -> None:
         while self._running:
             did_work = False
@@ -1482,6 +1507,10 @@ class EngineCore:
                 if self._drain_park_requested:
                     self._drain_park_requested = False
                     self._drain_park_all()
+                if self._park_rids:
+                    rids = self._park_rids
+                    self._park_rids = set()
+                    self._park_requested(rids)
                 if self._drain_flush_requested:
                     self._drain_flush_requested = False
                     self._drain_flush_all()
@@ -2205,7 +2234,10 @@ class EngineCore:
         pages = self._slot_pages[slot_id][: self._pages_for_tokens(tokens)]
         nbytes = len(pages) * kv_page_bytes(self.cfg, self.kv_page_size,
                                             quantized=self.quant.kv)
-        want_export = self.kv_ship and self.draining
+        # exports serve two callers: a draining engine spills EVERY park for
+        # the gateway's resume fetch; a healthy engine spills only parks the
+        # rebalancer explicitly requested (reason="migrate")
+        want_export = self.kv_ship and (self.draining or reason == "migrate")
         tier = self.kv_offload
         want_tier = tier is not None and tier.would_admit(nbytes)
         if not (want_export or want_tier):

@@ -556,7 +556,14 @@ class EngineAPI:
         adopter, so the mid-stream failover moves bytes instead of
         re-prefilling. One-shot: the payload is consumed by the fetch. 404
         when there is nothing for that id (never an error path for the
-        resume — the gateway just falls back to plain replay)."""
+        resume — the gateway just falls back to plain replay).
+
+        With {"park": true} (the rebalancer's proactive migration,
+        docs/resilience.md) the engine is asked to park that ONE stream
+        first: the step loop spills its KV at the next iteration and this
+        handler polls briefly for the payload. 404 past the poll window
+        means the stream was unparkable (mid-prefill, already finished) —
+        the migration aborts with the origin stream untouched."""
         try:
             body = await request.json()
         except Exception:
@@ -564,7 +571,16 @@ class EngineAPI:
         rid = body.get("request_id") if isinstance(body, dict) else None
         if not isinstance(rid, str) or not rid:
             return _error(400, "'request_id' must be a non-empty string")
-        payload = self.engine.core.take_kv_export(rid)
+        core = self.engine.core
+        if body.get("park"):
+            core.request_park(rid)
+            deadline = time.monotonic() + 2.0
+            payload = core.take_kv_export(rid)
+            while payload is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+                payload = core.take_kv_export(rid)
+        else:
+            payload = core.take_kv_export(rid)
         if payload is None:
             return _error(404, f"no KV export held for request {rid!r}")
         return web.json_response(

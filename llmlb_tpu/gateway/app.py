@@ -569,8 +569,10 @@ async def _api_health(request: web.Request) -> web.Response:
     if state.worker.multi:
         body["worker"] = {"index": state.worker.index,
                           "count": state.worker.count}
-        if state.gossip is not None:
-            body["gossip"] = state.gossip.stats()
+    # a single-worker host federated over the mesh still has peers worth
+    # showing (docs/deployment.md cross-host topology)
+    if state.gossip is not None:
+        body["gossip"] = state.gossip.stats()
     if state.resilience is not None:
         cfg = state.resilience.config
         body["resilience"] = {

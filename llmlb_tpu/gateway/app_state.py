@@ -46,10 +46,21 @@ from llmlb_tpu.gateway.db import Database
 from llmlb_tpu.gateway.events import DashboardEventBus
 from llmlb_tpu.gateway.faults import FaultInjector
 from llmlb_tpu.gateway.gate import InferenceGate
-from llmlb_tpu.gateway.gossip import GossipBus, default_gossip_dir
+from llmlb_tpu.gateway.gossip import (
+    MEMBER_KEY_PREFIX,
+    GossipBus,
+    GossipFaults,
+    MeshConfig,
+    default_gossip_dir,
+)
 from llmlb_tpu.gateway.health import EndpointHealthChecker
 from llmlb_tpu.gateway.metrics import GatewayMetrics
 from llmlb_tpu.gateway.ratelimit import RateLimiter
+from llmlb_tpu.gateway.rebalance import (
+    RebalanceConfig,
+    Rebalancer,
+    StreamDirectory,
+)
 from llmlb_tpu.gateway.registry import EndpointRegistry
 from llmlb_tpu.gateway.resilience import ResilienceManager
 from llmlb_tpu.gateway.tracing import TraceStore
@@ -201,6 +212,12 @@ class AppState:
     tray: object | None = None  # TrayController when LLMLB_TRAY=1
     worker: WorkerInfo = dataclasses.field(default_factory=WorkerInfo)
     gossip: GossipBus | None = None  # multi-worker state replication
+    # Fleet rebalancing (gateway/rebalance.py): every worker tracks its live
+    # streams in `streams`; the elected primary additionally runs the
+    # planner loop in `rebalancer`. LLMLB_REBALANCE=0 leaves the directory
+    # inert (register returns None) and the planner unconstructed.
+    streams: StreamDirectory | None = None
+    rebalancer: Rebalancer | None = None
     history: "HistoryWriter | None" = None
     started_at: float = dataclasses.field(default_factory=time.time)
     _tasks: list[asyncio.Task] = dataclasses.field(default_factory=list)
@@ -213,6 +230,8 @@ class AppState:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass  # allow-silent: shutdown teardown of cancelled tasks
+        if self.rebalancer is not None:
+            await self.rebalancer.stop()
         if self.health_checker:
             await self.health_checker.stop()
         if self.history is not None:
@@ -315,8 +334,10 @@ async def build_app_state(
     load_manager.resilience = resilience
     faults = FaultInjector.from_env()
 
-    # Per-API-key rate limits: worker-local, conservative (limits divide by
-    # the worker count — the group never exceeds the configured rate).
+    # Per-API-key rate limits: worker-local conservative shares by default
+    # (limits divide by the worker count — the group never exceeds the
+    # configured rate); promoted to fleet-global buckets below when the
+    # gossip bus starts (attach_gossip).
     ratelimit = RateLimiter(RateLimitConfig.from_env(), workers=worker.count)
 
     # Per-request history/daily-stat writes: synchronous single-worker (the
@@ -328,22 +349,29 @@ async def build_app_state(
         flush_interval_s=flush_s if flush_s > 0 else 0.5,
     )
 
+    # Live-stream directory: every worker tracks the streams it is pumping
+    # so rebalance directives (local or gossiped) can find them.
+    streams = StreamDirectory(RebalanceConfig.from_env())
+
     state = AppState(
         config=config, db=db, registry=registry, load_manager=load_manager,
         admission=admission, events=events, gate=gate, audit=audit, users=users, api_keys=api_keys,
         invitations=invitations, jwt_secret=jwt_secret, http=http,
         metrics=metrics, traces=traces, resilience=resilience, faults=faults,
-        ratelimit=ratelimit, worker=worker, history=history,
+        ratelimit=ratelimit, worker=worker, history=history, streams=streams,
     )
 
     _seed_tps_from_daily_stats(state)
 
-    # Gossip replication between sibling workers (LLMLB_GOSSIP=0 disables;
-    # single-worker gateways have no siblings and skip it entirely). All
-    # replicated state is advisory: breakers, TPS, retry budget, affinity
-    # pins, registry cache coherence — each converges locally without it.
-    if worker.multi and env_bool("LLMLB_GOSSIP", True):
-        state.gossip = await _start_gossip(state)
+    # Gossip replication between sibling workers — and, when
+    # LLMLB_GOSSIP_BIND configures the mesh, across hosts (LLMLB_GOSSIP=0
+    # disables both; a single-worker gateway with no mesh has no peers and
+    # skips it entirely). All replicated state is advisory: breakers, TPS,
+    # retry budget, affinity pins, adapter residency, heat, rate-limit
+    # spend, registry cache coherence — each converges locally without it.
+    mesh = MeshConfig.from_env()
+    if (worker.multi or mesh.enabled) and env_bool("LLMLB_GOSSIP", True):
+        state.gossip = await _start_gossip(state, mesh)
 
     if start_background:
         audit.start()
@@ -369,44 +397,79 @@ async def build_app_state(
                 resilience=resilience,
             )
             checker.start()
+            checker.gossip = state.gossip  # residency push (health.py)
             state.health_checker = checker
             state._tasks.append(
                 asyncio.create_task(_maintenance_loop(state),
                                     name="gw-maintenance")
             )
+            # Proactive rebalancer (gateway/rebalance.py): same primary-only
+            # single-writer discipline as the probe loop it reads from.
+            rb = Rebalancer(
+                registry, load_manager, streams, metrics=metrics,
+                gossip=state.gossip, config=streams.config,
+            )
+            rb.start()
+            state.rebalancer = rb
     return state
 
 
-async def _start_gossip(state: AppState) -> GossipBus:
-    """Bind this worker's bus socket and wire every replicated-state hook.
-    Receivers apply via ``apply_remote_*`` entry points that never
-    re-publish, so a two-worker group cannot ping-pong a message forever."""
+async def _start_gossip(state: AppState,
+                        mesh: MeshConfig | None = None) -> GossipBus:
+    """Bind this worker's bus socket (plus the UDP/TCP mesh when configured)
+    and wire every replicated-state hook. Receivers apply via
+    ``apply_remote_*`` entry points that never re-publish, so a two-worker
+    group cannot ping-pong a message forever. Conflict resolution is the
+    (seq, origin) version in ``m["ver"]`` — never the wall stamp."""
+    mesh = mesh or MeshConfig.from_env()
+    db = state.db
+    membership = register = None
+    if mesh.enabled:
+        # Membership from the endpoint-registry database: every host
+        # persists its advertised mesh address under a settings key, so any
+        # host that can reach the shared DB finds the fleet without config.
+        def membership() -> dict:
+            return {
+                key[len(MEMBER_KEY_PREFIX):]: value
+                for key, value in db.list_settings().items()
+                if key.startswith(MEMBER_KEY_PREFIX) and value
+            }
+
+        def register(origin: str, advertise: str) -> None:
+            db.set_setting(MEMBER_KEY_PREFIX + origin, advertise)
+
     bus = GossipBus(
         default_gossip_dir(state.config.port), state.worker.index,
         expected_peers=state.worker.count - 1,
+        mesh=mesh, faults=GossipFaults.from_env(),
+        membership=membership, register=register,
     )
     await bus.start()
     lm = state.load_manager
     resilience = state.resilience
     registry = state.registry
+    bus.on_lag = state.metrics.observe_gossip_lag
 
     lm.gossip = bus
     bus.subscribe("tps", lambda d, m: lm.apply_remote_tps(
         d["eid"], d["model"], d["kind"], float(d["ema"]),
-        int(d.get("samples", 1)), m["ts"],
+        int(d.get("samples", 1)), m["ver"],
     ))
-    bus.subscribe("tps_clear", lambda d, m: lm.clear_tps_for_endpoint(
-        d["eid"], _publish=False,
+    bus.subscribe("tps_clear", lambda d, m: lm.apply_remote_tps_clear(
+        d["eid"], m["ver"],
     ))
     bus.subscribe("affinity", lambda d, m: lm.apply_remote_affinity(
-        d["model"], d["hash"], d["eid"], m["ts"],
+        d["model"], d["hash"], d["eid"], m["ver"],
+    ))
+    bus.subscribe("heat", lambda d, m: lm.apply_remote_heat(
+        d["model"], d.get("entries") or {}, m["ver"],
     ))
     if resilience is not None:
         resilience.gossip = bus
         resilience.budget.on_spend = lambda: bus.publish("retry_spend", {})
         bus.subscribe("breaker", lambda d, m: resilience.apply_remote_breaker(
             d["eid"], d["to"], float(d.get("remaining_s", 0.0)),
-            d.get("reason"), m["ts"],
+            d.get("reason"), m["ver"],
         ))
         bus.subscribe(
             "retry_spend",
@@ -414,6 +477,24 @@ async def _start_gossip(state: AppState) -> GossipBus:
         )
     registry.on_mutate = lambda: bus.publish("registry", {})
     bus.subscribe("registry", lambda d, m: registry.reload())
+    # Event-driven adapter residency: the primary's probe loop pushes
+    # resident-set changes; siblings patch their model cache immediately
+    # instead of waiting out a full registry reload round.
+    bus.subscribe("residency", lambda d, m: registry.apply_residency(
+        d["eid"], list((d.get("adapters") or {})),
+    ))
+    # Global token buckets: admission spend replicates fleet-wide so a
+    # tenant at rps=N is admitted ≈N across all workers, not N×workers.
+    if state.ratelimit is not None and state.ratelimit.enabled:
+        state.ratelimit.attach_gossip(bus)
+    # Rebalance directives from the (possibly remote) primary: mark up to
+    # max_streams of OUR live streams on the source endpoint for migration.
+    streams = state.streams
+    if streams is not None and streams.config.enabled:
+        bus.subscribe("migrate", lambda d, m: streams.apply_directive(
+            d["eid"], d["target"], d.get("reason") or "hotspot",
+            int(d.get("max_streams", 1)), int(d.get("directive_id", 0)),
+        ))
     return bus
 
 
@@ -469,8 +550,26 @@ def gateway_exposition(state: AppState) -> str:
         counters["llmlb_gateway_gossip_send_errors_total"] = (
             gs["send_errors_total"]
         )
+        counters["llmlb_gateway_gossip_rejected_total"] = (
+            gs["recv_rejected_total"]
+        )
+        counters["llmlb_gateway_gossip_fault_dropped_total"] = (
+            gs["fault_dropped_total"]
+        )
+        gauges["llmlb_gateway_gossip_peers"] = (
+            gs["peers"] + gs["mesh_peers"]
+        )
+        gauges["llmlb_gateway_gossip_partition_suspected"] = (
+            1 if gs["partition_suspected"] else 0
+        )
         if gs["lag_s"] is not None:
             gauges["llmlb_gateway_gossip_lag_seconds"] = round(gs["lag_s"], 6)
+    if state.rebalancer is not None:
+        rb = state.rebalancer.snapshot()
+        counters["llmlb_gateway_rebalance_directives_total"] = (
+            rb["directives_total"]
+        )
+        gauges["llmlb_gateway_rebalance_inflight"] = rb["inflight"]
     return state.metrics.render(counters=counters, gauges=gauges)
 
 

@@ -46,7 +46,8 @@ import time
 import typing
 from collections import OrderedDict, defaultdict, deque
 
-from llmlb_tpu.gateway.config import QueueConfig
+from llmlb_tpu.gateway.config import QueueConfig, env_bool
+from llmlb_tpu.gateway.gossip import SeqClock, Version, newer
 from llmlb_tpu.gateway.types import Endpoint, TpsApiKind
 
 TPS_EMA_ALPHA = 0.2  # parity: balancer/types.rs:109
@@ -99,6 +100,11 @@ def prefix_affinity_hash(model: str, text: str,
 # slowly, and per-request fan-out would put a datagram on the bus for every
 # completion.
 TPS_GOSSIP_MIN_INTERVAL_S = 1.0
+
+# Prefix-heat gossip: batch locally observed (hash → endpoint, hits) deltas
+# and flush at most this often, so a hot shared prefix costs one datagram
+# per interval, not one per request.
+HEAT_GOSSIP_MIN_INTERVAL_S = 1.0
 
 AFFINITY_MODES = ("lru", "ring")
 
@@ -252,19 +258,39 @@ class LoadManager:
         self.gossip = None
         self._tps_pub_ts: dict[tuple[str, str, str], float] = {}
         self._lock = threading.Lock()
+        # Seq-LWW versions (gossip.newer): per-key (seq, origin) stamps for
+        # TPS/affinity state plus per-endpoint clear tombstones, so a
+        # delayed datagram from before a clear can never resurrect stale
+        # state — wall stamps don't order across hosts. The local clock is
+        # the fallback when no bus is attached (single worker).
+        self._local_clock = SeqClock()
+        self._tps_ver: dict[tuple[str, str, str], Version] = {}
+        self._clear_ver: dict[str, Version] = {}
         # (endpoint_id, model, api_kind) -> ModelTpsState
         self._tps: dict[tuple[str, str, str], ModelTpsState] = {}
         self._active: dict[str, int] = defaultdict(int)
         self._rr_counter: dict[str, int] = defaultdict(int)  # round-robin per model
         self._history: deque[RequestRecord] = deque()
         self._total_requests = 0
-        # (model, prefix_hash) -> (endpoint_id, recorded_at); bounded LRU
-        self._affinity: OrderedDict[tuple[str, str], tuple[str, float]] = (
-            OrderedDict()
-        )
+        # (model, prefix_hash) -> (endpoint_id, recorded_at, version);
+        # recorded_at is LOCAL receipt time (TTL only — skew-free),
+        # version is the seq-LWW stamp. Bounded LRU.
+        self._affinity: OrderedDict[
+            tuple[str, str], tuple[str, float, Version]
+        ] = OrderedDict()
         self._affinity_hits = 0
         self._affinity_misses = 0
         self._affinity_evictions = 0
+        # Prefix-heat map (LLMLB_AFFINITY_HEAT, ring mode): which endpoint
+        # ACTUALLY holds each hot prefix cached, learned locally and over
+        # gossip — ring selection prefers a live under-cap holder before
+        # the rendezvous owner, so steering follows real cache contents
+        # after endpoint churn/migration instead of pure hash topology.
+        # (model, prefix_hash) -> [endpoint_id, hits, version]; bounded LRU.
+        self.affinity_heat = env_bool("LLMLB_AFFINITY_HEAT", False)
+        self._heat: OrderedDict[tuple[str, str], list] = OrderedDict()
+        self._heat_pending: dict[str, dict[str, list]] = {}
+        self._heat_pub_ts = 0.0
         # In-band per-endpoint outcome stats (resilience layer feeds these;
         # stream interruptions land here too — before this, a stream that
         # died mid-flight never counted against its endpoint because the
@@ -313,6 +339,7 @@ class LoadManager:
         if self._rc is not None:
             self._rc.update_tps(endpoint_id, model, api_kind.value,
                                 tokens, duration_s, time.time())
+            self._stamp_tps(endpoint_id, model, api_kind.value)
             self._maybe_gossip_tps(endpoint_id, model, api_kind.value)
             return
         if duration_s <= 0 or tokens <= 0:
@@ -321,9 +348,27 @@ class LoadManager:
             key = (endpoint_id, model, api_kind.value)
             state = self._tps.setdefault(key, ModelTpsState())
             state.update(tokens, duration_s)
+        self._stamp_tps(endpoint_id, model, api_kind.value)
         self._maybe_gossip_tps(endpoint_id, model, api_kind.value)
 
+    def _stamp_tps(self, endpoint_id: str, model: str, kind: str) -> None:
+        """A local in-band measurement outranks every gossip message this
+        worker has already witnessed (Lamport: the tick is causally after
+        them) — a delayed stale datagram can never override it."""
+        ver = self._next_ver()
+        with self._lock:
+            self._tps_ver[(endpoint_id, model, kind)] = ver
+
     # --------------------------------------------------------- tps replication
+
+    def _next_ver(self) -> Version:
+        """Allocate a fresh seq-LWW version: the bus's Lamport clock when
+        gossip is attached (so local stamps and wire stamps share one
+        order), a process-local clock otherwise."""
+        g = self.gossip
+        if g is not None:
+            return g.next_version()
+        return (self._local_clock.tick(), "local")
 
     def _tps_info(self, endpoint_id: str, model: str,
                   kind: str) -> tuple[float, int, float] | None:
@@ -354,23 +399,29 @@ class LoadManager:
                           "ema": ema, "samples": samples})
 
     def apply_remote_tps(self, endpoint_id: str, model: str, kind: str,
-                         ema: float, samples: int, ts: float) -> None:
-        """A sibling worker's EMA, applied last-writer-wins: older than what
-        this worker measured itself is dropped. Never re-gossips."""
-        info = self._tps_info(endpoint_id, model, kind)
-        if info is not None and info[2] >= ts:
-            return
-        if self._rc is not None:
-            self._rc.seed_tps(endpoint_id, model, kind, ema,
-                              max(1, samples), ts)
-            return
+                         ema: float, samples: int, ver: Version) -> None:
+        """A sibling worker's EMA, applied seq-LWW: not newer than this
+        worker's own stamp (or the endpoint's clear tombstone) is dropped —
+        wall stamps skew across hosts and silently resurrected stale state;
+        (seq, origin) versions don't. Never re-gossips."""
+        ver = tuple(ver)
+        key = (endpoint_id, model, kind)
         with self._lock:
-            local = self._tps.get((endpoint_id, model, kind))
-            if local is not None and local.last_update >= ts:
-                return  # re-check under the lock: a racing local sample wins
-            self._tps[(endpoint_id, model, kind)] = ModelTpsState(
-                ema_tps=ema, samples=max(1, samples), last_update=ts
-            )
+            if not newer(ver, self._clear_ver.get(endpoint_id)):
+                return
+            if not newer(ver, self._tps_ver.get(key)):
+                return
+            self._tps_ver[key] = ver
+            if self._rc is None:
+                self._tps[key] = ModelTpsState(
+                    ema_tps=ema, samples=max(1, samples),
+                    last_update=time.time(),
+                )
+        if self._rc is not None:
+            # local wall only feeds the native core's same-process staleness
+            # bookkeeping; cross-worker ordering was decided above
+            self._rc.seed_tps(endpoint_id, model, kind, ema,
+                              max(1, samples), time.time())
 
     def seed_tps(self, endpoint_id: str, model: str, api_kind: TpsApiKind,
                  ema_tps: float, samples: int = 1) -> None:
@@ -400,10 +451,34 @@ class LoadManager:
         steering shared-prefix traffic at a flapping endpoint. The clear
         gossips to sibling workers (the pull checker that noticed the
         failure runs in one elected worker only)."""
+        ver = self._next_ver()
+        self._clear_endpoint_state(endpoint_id, ver)
+        if _publish and self.gossip is not None:
+            self.gossip.publish("tps_clear", {"eid": endpoint_id},
+                                seq=ver[0])
+
+    def apply_remote_tps_clear(self, endpoint_id: str, ver: Version) -> None:
+        """A sibling's clear, tombstoned with the WIRE version: any tps or
+        affinity datagram published before the clear (lower version) is
+        dropped on arrival — no stale-state resurrection, however delayed
+        or reordered the transport got. Never re-gossips."""
+        ver = tuple(ver)
         with self._lock:
-            for key in [k for k, (eid, _) in self._affinity.items()
-                        if eid == endpoint_id]:
+            if not newer(ver, self._clear_ver.get(endpoint_id)):
+                return
+        self._clear_endpoint_state(endpoint_id, ver)
+
+    def _clear_endpoint_state(self, endpoint_id: str, ver: Version) -> None:
+        with self._lock:
+            self._clear_ver[endpoint_id] = ver
+            for key in [k for k, v in self._affinity.items()
+                        if v[0] == endpoint_id]:
                 del self._affinity[key]
+            for key in [k for k in self._tps_ver if k[0] == endpoint_id]:
+                del self._tps_ver[key]
+            for key in [k for k, v in self._heat.items()
+                        if v[0] == endpoint_id]:
+                del self._heat[key]
         if self._rc is not None:
             self._rc.clear_endpoint(endpoint_id)
         else:
@@ -411,8 +486,6 @@ class LoadManager:
                 self._tps = {
                     k: v for k, v in self._tps.items() if k[0] != endpoint_id
                 }
-        if _publish and self.gossip is not None:
-            self.gossip.publish("tps_clear", {"eid": endpoint_id})
 
     def tps_snapshot(self) -> dict[str, dict]:
         if self._rc is not None:
@@ -434,7 +507,7 @@ class LoadManager:
         got = self._affinity.get(key)
         if got is None:
             return None
-        endpoint_id, ts = got
+        endpoint_id, ts, _ver = got
         if time.time() - ts > PREFIX_AFFINITY_TTL_S:
             del self._affinity[key]
             return None
@@ -446,7 +519,7 @@ class LoadManager:
         (the only cases worth gossiping — refreshes are noise)."""
         key = (model, prefix_hash)
         prev = self._affinity.get(key)
-        self._affinity[key] = (endpoint_id, time.time())
+        self._affinity[key] = (endpoint_id, time.time(), self._next_ver())
         self._affinity.move_to_end(key)
         while len(self._affinity) > PREFIX_AFFINITY_CAPACITY:
             self._affinity.popitem(last=False)
@@ -461,23 +534,92 @@ class LoadManager:
             })
 
     def apply_remote_affinity(self, model: str, prefix_hash: str,
-                              endpoint_id: str, ts: float) -> None:
+                              endpoint_id: str, ver: Version) -> None:
         """A sibling worker pinned this prefix (lru mode only — ring mode
-        needs no replication, the hash IS the agreement). Stored with the
-        remote stamp so TTL expiry and last-writer-wins stay consistent;
-        never counted as hit/miss, never re-gossiped."""
+        needs no replication, the hash IS the agreement). Seq-LWW on the
+        wire version; TTL runs on LOCAL receipt time (remote wall stamps
+        would expire early/late under cross-host skew). Never counted as
+        hit/miss, never re-gossiped."""
         if self.affinity_mode != "lru":
             return
+        ver = tuple(ver)
         with self._lock:
+            if not newer(ver, self._clear_ver.get(endpoint_id)):
+                return
             key = (model, prefix_hash)
             cur = self._affinity.get(key)
-            if cur is not None and cur[1] >= ts:
+            if cur is not None and not newer(ver, cur[2]):
                 return
-            self._affinity[key] = (endpoint_id, ts)
+            self._affinity[key] = (endpoint_id, time.time(), ver)
             self._affinity.move_to_end(key)
             while len(self._affinity) > PREFIX_AFFINITY_CAPACITY:
                 self._affinity.popitem(last=False)
                 self._affinity_evictions += 1
+
+    # ----------------------------------------------------------- prefix heat
+
+    def _heat_note_locked(self, model: str, prefix_hash: str,
+                          endpoint_id: str) -> None:
+        """One request for this prefix actually served by `endpoint_id` —
+        its KV cache now (still) holds the prefix. Caller holds _lock."""
+        key = (model, prefix_hash)
+        entry = self._heat.get(key)
+        if entry is not None and entry[0] == endpoint_id:
+            entry[1] += 1
+        else:
+            entry = [endpoint_id, 1, self._next_ver()]
+            self._heat[key] = entry
+        self._heat.move_to_end(key)
+        while len(self._heat) > PREFIX_AFFINITY_CAPACITY:
+            self._heat.popitem(last=False)
+        self._heat_pending.setdefault(model, {})[prefix_hash] = [
+            endpoint_id, entry[1],
+        ]
+
+    def _maybe_gossip_heat(self) -> None:
+        """Flush batched heat deltas at most once per interval (call sites
+        must NOT hold _lock — publish writes to sockets)."""
+        g = self.gossip
+        if g is None or not self.affinity_heat:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if (not self._heat_pending
+                    or now - self._heat_pub_ts < HEAT_GOSSIP_MIN_INTERVAL_S):
+                return
+            self._heat_pub_ts = now
+            pending, self._heat_pending = self._heat_pending, {}
+        for model, entries in pending.items():
+            g.publish("heat", {"model": model, "entries": entries})
+
+    def apply_remote_heat(self, model: str, entries: dict,
+                          ver: Version) -> None:
+        """A sibling's heat deltas: seq-LWW per entry, hit counts merge
+        monotonically when both workers agree on the holder. Never
+        re-gossips."""
+        ver = tuple(ver)
+        with self._lock:
+            for prefix_hash, value in entries.items():
+                if not (isinstance(value, (list, tuple)) and len(value) >= 2):
+                    continue
+                eid, hits = str(value[0]), int(value[1])
+                if not newer(ver, self._clear_ver.get(eid)):
+                    continue
+                key = (model, str(prefix_hash))
+                cur = self._heat.get(key)
+                if cur is not None and cur[0] == eid:
+                    cur[1] = max(cur[1], hits)
+                    cur[2] = max(cur[2], ver)
+                elif cur is None or newer(ver, cur[2]):
+                    self._heat[key] = [eid, hits, ver]
+                self._heat.move_to_end(key)
+            while len(self._heat) > PREFIX_AFFINITY_CAPACITY:
+                self._heat.popitem(last=False)
+
+    def _heat_endpoint_locked(self, model: str,
+                              prefix_hash: str) -> str | None:
+        entry = self._heat.get((model, prefix_hash))
+        return entry[0] if entry is not None else None
 
     def _affinity_endpoint(self, model: str,
                            prefix_hash: str | None) -> str | None:
@@ -502,6 +644,14 @@ class LoadManager:
         if prefix_hash is None:
             return None
         if self.affinity_mode == "ring":
+            if self.affinity_heat:
+                # steer by what is ACTUALLY cached where, when known: a
+                # migrated/churned prefix keeps hitting its warm engine
+                # instead of the (cold) rendezvous owner
+                with self._lock:
+                    hot = self._heat_endpoint_locked(model, prefix_hash)
+                if hot is not None and any(ep.id == hot for ep in endpoints):
+                    return hot
             return self._hrw_owner(prefix_hash, [ep.id for ep in endpoints])
         return self._affinity_endpoint(model, prefix_hash)
 
@@ -514,12 +664,15 @@ class LoadManager:
             if self.affinity_mode == "lru":
                 changed = self._affinity_note_locked(model, prefix_hash,
                                                      endpoint_id)
+            if self.affinity_heat:
+                self._heat_note_locked(model, prefix_hash, endpoint_id)
             if hit:
                 self._affinity_hits += 1
             else:
                 self._affinity_misses += 1
         if changed:
             self._gossip_affinity(model, prefix_hash, endpoint_id)
+        self._maybe_gossip_heat()
 
     def affinity_stats(self) -> dict:
         """Prefix-affinity figures for the gateway /metrics exposition."""
@@ -529,6 +682,7 @@ class LoadManager:
                 "hits_total": self._affinity_hits,
                 "misses_total": self._affinity_misses,
                 "evictions_total": self._affinity_evictions,
+                "heat_entries": len(self._heat),
             }
 
     # ------------------------------------------------------ endpoint outcomes
@@ -664,11 +818,21 @@ class LoadManager:
                 # Consistent-hash owner over the permitted set (not just the
                 # under-cap candidates): an at-cap owner counts a miss and
                 # falls through to scoring rather than silently remapping —
-                # the key snaps back the moment capacity frees.
-                owner = self._hrw_owner(prefix_hash,
-                                        [ep.id for ep in endpoints])
+                # the key snaps back the moment capacity frees. With the
+                # heat map on, a live under-cap endpoint KNOWN to hold the
+                # prefix cached outranks the hash owner.
+                owner = None
+                if self.affinity_heat:
+                    owner = self._heat_endpoint_locked(model, prefix_hash)
+                    if not any(ep.id == owner for ep in candidates):
+                        owner = None
+                if owner is None:
+                    owner = self._hrw_owner(prefix_hash,
+                                            [ep.id for ep in endpoints])
                 for ep in candidates:
                     if ep.id == owner:
+                        if self.affinity_heat:
+                            self._heat_note_locked(model, prefix_hash, ep.id)
                         self._affinity_hits += 1
                         return ep
                 self._affinity_misses += 1

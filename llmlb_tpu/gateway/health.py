@@ -122,6 +122,10 @@ class EndpointHealthChecker:
         # this pull checker — a good probe fast-forwards an open breaker to
         # half-open, a recovered-from-offline endpoint gets a fresh breaker.
         self.resilience = resilience
+        # GossipBus | None (wired by app_state): resident-adapter changes
+        # push to sibling workers the moment a probe observes them, instead
+        # of each sibling waiting out its own registry reload.
+        self.gossip = None
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -298,6 +302,14 @@ class EndpointHealthChecker:
         if current == set(wanted):
             return
         self.registry.sync_models(ep.id, base + list(wanted.values()))
+        # Event-driven residency (docs/lora.md): the resident set CHANGED —
+        # push it so siblings (and mesh peers) patch their caches now, one
+        # gossip hop instead of one probe/reload interval.
+        if self.gossip is not None:
+            self.gossip.publish("residency", {
+                "eid": ep.id,
+                "adapters": {name: 1 for name in acc.lora_loaded},
+            })
 
     async def _on_recovery(self, ep: Endpoint) -> None:
         """Re-detect type (it may have been swapped) and resync models."""

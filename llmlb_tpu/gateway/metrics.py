@@ -24,6 +24,11 @@ resilience-layer series (gateway/resilience.py):
   llmlb_gateway_breaker_state{endpoint}                  gauge (0/1/2)
   llmlb_gateway_stream_interruptions_total{model,endpoint} counter
   llmlb_gateway_faults_injected_total{kind}              counter
+fleet-federation series (gateway/rebalance.py, gateway/gossip.py):
+  llmlb_gateway_rebalance_migrations_total{reason,outcome} counter
+  llmlb_gateway_gossip_delay_seconds                     histogram
+  (plus gossip_peers / gossip_partition_suspected scrape-time gauges
+   injected by the /metrics handler, docs/monitoring/README.md)
 SLO goodput series (targets from SloConfig, docs/profiling.md):
   llmlb_gateway_slo_eligible_total{model}   counter (requests judged)
   llmlb_gateway_slo_met_total{model}        counter (met every target)
@@ -92,6 +97,10 @@ E2E_BUCKETS = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                60.0, 120.0)
 QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                       5.0, 10.0, 30.0)
+# One-way gossip delivery delay: sub-ms on a unix socket, tens of ms across
+# hosts, seconds when a delay fault or congested mesh is in play.
+GOSSIP_LAG_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                      1.0, 2.5, 5.0)
 
 
 def _escape(value: str) -> str:
@@ -122,6 +131,14 @@ class GatewayMetrics:
         self._breaker_state: dict[str, int] = {}
         self._stream_interruptions: dict[tuple[str, str], int] = defaultdict(int)
         self._faults_injected: dict[str, int] = defaultdict(int)
+        # fleet federation (gateway/rebalance.py): proactive live-stream
+        # migrations by (reason=hotspot|drain|restart, outcome=success|
+        # aborted|refused|skipped) — distinct from stream_resumes, which
+        # counts REACTIVE failure recovery
+        self._rebalance_migrations: dict[tuple[str, str], int] = defaultdict(int)
+        # one-way gossip delivery delay per received message (wall-clock
+        # derived, diagnostic only — see gossip.py module docstring)
+        self._gossip_lag = Histogram(GOSSIP_LAG_BUCKETS)
         # structured outputs (llmlb_tpu/structured): requests that asked for
         # grammar-constrained decoding, by kind, and requests rejected 400
         # at gateway-side validation (malformed / unsupported schema)
@@ -227,6 +244,20 @@ class GatewayMetrics:
     def record_fault_injected(self, kind: str) -> None:
         with self._lock:
             self._faults_injected[kind] += 1
+
+    def record_rebalance_migration(self, reason: str, outcome: str) -> None:
+        """One proactive migration attempt resolved by the rebalancer;
+        reason is hotspot / drain / restart, outcome is success (stream now
+        lives on the target), refused (target would not adopt; stream stayed
+        put), aborted (mid-flight failure, fell back to the reactive resume
+        path) or skipped (budget / window guard)."""
+        with self._lock:
+            self._rebalance_migrations[(reason, outcome)] += 1
+
+    def observe_gossip_lag(self, seconds: float) -> None:
+        """One-way delivery delay of one received gossip message."""
+        with self._lock:
+            self._gossip_lag.observe(max(0.0, seconds))
 
     def record_structured_request(self, kind: str) -> None:
         """One request asking for constrained decoding; `kind` is
@@ -375,6 +406,11 @@ class GatewayMetrics:
                 "stream_write_timeouts_total":
                     sum(self._stream_write_timeouts.values()),
                 "stream_resumes": dict(self._stream_resumes),
+                "rebalance_migrations": {
+                    f"{reason}/{outcome}": n
+                    for (reason, outcome), n
+                    in sorted(self._rebalance_migrations.items())
+                },
                 "stream_resumed_tokens_total":
                     sum(self._stream_resumed_tokens.values()),
                 "goodput_by_priority": {
@@ -473,6 +509,39 @@ class GatewayMetrics:
                 lines.append(
                     f'llmlb_gateway_faults_injected_total'
                     f'{{kind="{_escape(kind)}"}} {n}'
+                )
+            lines.append(
+                "# TYPE llmlb_gateway_rebalance_migrations_total counter"
+            )
+            for (reason, outcome), n in sorted(
+                self._rebalance_migrations.items()
+            ):
+                lines.append(
+                    f'llmlb_gateway_rebalance_migrations_total'
+                    f'{{reason="{_escape(reason)}",'
+                    f'outcome="{_escape(outcome)}"}} {n}'
+                )
+            lines.append("# TYPE llmlb_gateway_gossip_delay_seconds histogram")
+            if self._gossip_lag.n > 0:
+                cumulative = 0
+                for i, edge in enumerate(self._gossip_lag.edges):
+                    cumulative += self._gossip_lag.counts[i]
+                    lines.append(
+                        f'llmlb_gateway_gossip_delay_seconds_bucket'
+                        f'{{le="{edge}"}} {cumulative}'
+                    )
+                cumulative += self._gossip_lag.counts[-1]
+                lines.append(
+                    f'llmlb_gateway_gossip_delay_seconds_bucket'
+                    f'{{le="+Inf"}} {cumulative}'
+                )
+                lines.append(
+                    f"llmlb_gateway_gossip_delay_seconds_sum "
+                    f"{self._gossip_lag.total}"
+                )
+                lines.append(
+                    f"llmlb_gateway_gossip_delay_seconds_count "
+                    f"{self._gossip_lag.n}"
                 )
             lines.append(
                 "# TYPE llmlb_gateway_structured_requests_total counter"

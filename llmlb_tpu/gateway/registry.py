@@ -159,6 +159,36 @@ class EndpointRegistry:
             self._models[endpoint_id] = list(models)
         self._notify_mutation()
 
+    def apply_residency(self, endpoint_id: str, adapters: list[str]) -> None:
+        """Patch the cached `base:adapter` model entries for an endpoint to
+        exactly `adapters` — the gossip fast path for adapter residency
+        (health._sync_lora_models pushes changes the moment a probe sees
+        them). Cache-only like set_breaker_state: the primary's sync_models
+        already persisted the truth to the shared DB, and a full reload
+        rides the `registry` gossip behind this message anyway — this just
+        closes the window where a sibling routes on a stale resident set."""
+        with self._lock:
+            models = self._models.get(endpoint_id)
+            if not models:
+                return
+            base = [m for m in models if ":" not in m.model_id]
+            lora_base = [m for m in base
+                         if Capability.LORA in m.capabilities]
+            if not lora_base:
+                return
+            wanted: dict[str, EndpointModel] = {}
+            for m in lora_base:
+                for name in adapters:
+                    mid = f"{m.model_id}:{name}"
+                    wanted[mid] = EndpointModel(
+                        endpoint_id=endpoint_id,
+                        model_id=mid,
+                        canonical_name=f"{m.canonical_name}:{name}",
+                        capabilities=list(m.capabilities),
+                        context_length=m.context_length,
+                    )
+            self._models[endpoint_id] = base + list(wanted.values())
+
     def models_for(self, endpoint_id: str) -> list[EndpointModel]:
         with self._lock:
             return list(self._models.get(endpoint_id, []))

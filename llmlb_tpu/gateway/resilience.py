@@ -50,6 +50,7 @@ from llmlb_tpu.gateway.faults import (
     InjectedHTTPResponse,
     StreamCutResponse,
 )
+from llmlb_tpu.gateway.gossip import SeqClock, newer
 
 RETRYABLE_EXCEPTIONS = (aiohttp.ClientError, asyncio.TimeoutError, OSError)
 
@@ -80,7 +81,7 @@ class _Breaker:
 
     __slots__ = ("state", "consecutive_failures", "opened_at", "open_until",
                  "trip_streak", "probes_in_flight", "probe_started_at",
-                 "last_failure_reason", "last_change_wall")
+                 "last_failure_reason", "last_change_ver")
 
     def __init__(self):
         self.state = BreakerState.CLOSED
@@ -91,9 +92,11 @@ class _Breaker:
         self.probes_in_flight = 0
         self.probe_started_at = 0.0
         self.last_failure_reason: str | None = None
-        # wall-clock stamp of the last applied transition (local or remote):
-        # the LWW ordering key for cross-worker gossip (same-host clocks)
-        self.last_change_wall = 0.0
+        # (seq, origin) stamp of the last applied transition (local or
+        # remote): the seq-LWW ordering key for cross-worker gossip — wall
+        # stamps skewed across hosts and could resurrect a stale OPEN
+        # (gossip.newer); None until the first transition.
+        self.last_change_ver: tuple | None = None
 
 
 class RetryBudget:
@@ -205,6 +208,13 @@ class ResilienceManager:
         # still converges on its own in-band failures.
         self.gossip = None
         self._applying_remote = False  # loop guard: remote applies don't re-gossip
+        self._local_clock = SeqClock()  # version source when no bus attached
+
+    def _next_ver(self):
+        g = self.gossip
+        if g is not None:
+            return g.next_version()
+        return (self._local_clock.tick(), "local")
 
     # ------------------------------------------------------------ transitions
 
@@ -217,7 +227,8 @@ class ResilienceManager:
         if frm == to:
             return
         b.state = to
-        b.last_change_wall = time.time()
+        ver = self._next_ver()
+        b.last_change_ver = ver
         if to == BreakerState.OPEN:
             now = time.monotonic()
             b.opened_at = now
@@ -254,6 +265,8 @@ class ResilienceManager:
                 "reason": reason,
             })
         if self.gossip is not None and not self._applying_remote:
+            # the wire version IS the local stamp (seq=ver[0]): a delayed
+            # echo of an older remote transition can never outrank this one
             self.gossip.publish("breaker", {
                 "eid": endpoint_id,
                 "to": to.value,
@@ -264,12 +277,12 @@ class ResilienceManager:
                     round(max(0.0, b.open_until - time.monotonic()), 3)
                     if to == BreakerState.OPEN else 0.0
                 ),
-            })
+            }, seq=ver[0])
 
     def apply_remote_breaker(self, endpoint_id: str, to: str,
                              remaining_s: float, reason: str | None,
-                             ts: float) -> None:
-        """A sibling worker's breaker transition, applied last-writer-wins.
+                             ver: tuple) -> None:
+        """A sibling worker's breaker transition, applied seq-LWW.
 
         OPEN ejects the endpoint here with the peer's remaining interval (so
         the whole group reopens together); CLOSED/HALF_OPEN relax a local
@@ -286,9 +299,10 @@ class ResilienceManager:
         if (self.registry is not None
                 and self.registry.get(endpoint_id) is None):
             return  # deleted endpoint: never resurrect its breaker
+        ver = tuple(ver)
         with self._lock:
             b = self._breakers.setdefault(endpoint_id, _Breaker())
-            if ts <= b.last_change_wall:
+            if not newer(ver, b.last_change_ver):
                 return  # stale: this worker already knows something newer
             self._applying_remote = True
             try:
@@ -307,7 +321,10 @@ class ResilienceManager:
                 elif b.state != BreakerState.CLOSED:
                     self._transition(endpoint_id, b, BreakerState.CLOSED,
                                      f"gossip: {reason}")
-                b.last_change_wall = ts
+                # adopt the WIRE version: this worker's state now equals the
+                # sender's, so anything newer than the sender's stamp (and
+                # only that) should supersede it here too
+                b.last_change_ver = ver
             finally:
                 self._applying_remote = False
 

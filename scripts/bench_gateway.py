@@ -3137,6 +3137,262 @@ async def run_chaos_engine_kill(streams: int = 8,
         await gw.close()
 
 
+def _openai_sse_text(body: bytes) -> str:
+    """Concatenated delta content of an OpenAI chat SSE body."""
+    parts = []
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        data = line[len(b"data:"):].strip()
+        if not data or data == b"[DONE]":
+            continue
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            continue
+        for choice in obj.get("choices") or []:
+            content = (choice.get("delta") or {}).get("content")
+            if isinstance(content, str):
+                parts.append(content)
+    return "".join(parts)
+
+
+async def run_rebalance_bench(streams: int = 12) -> dict:
+    """Zero-downtime rebalancing drill (docs/resilience.md): two scenarios
+    against the real gateway pump + mock resumable engines.
+
+    - ``rolling_restart``: >= `streams` concurrent LIVE streams across
+      three engines; each engine in turn advertises draining and the
+      rebalancer evacuates it through park-export → resume while the
+      clients keep reading. Bars: 100% client success, 100% token-identical
+      output, zero terminal SSE error frames, every engine fully evacuated
+      while draining.
+    - ``hotspot``: background streams decode on a slow overloaded engine;
+      a fast idle engine appears. Run twice — LLMLB_REBALANCE off
+      (baseline: streams stay put) vs on (hot-spot directives migrate
+      them) — and compare client-observed inter-chunk ITL p99. Bars:
+      >= 1 hotspot/success migration, token identity in BOTH modes, and
+      the rebalanced ITL p99 beating the pinned baseline.
+
+    Exit code 1 when any bar is missed.
+    """
+    from llmlb_tpu.gateway.config import ResilienceConfig
+    from llmlb_tpu.gateway.faults import FaultInjector
+    from llmlb_tpu.gateway.rebalance import RebalanceConfig, Rebalancer
+    from llmlb_tpu.gateway.resilience import ResilienceManager
+    from llmlb_tpu.gateway.types import AcceleratorInfo, EndpointType
+    from tests.support import GatewayHarness, MockResumableEndpoint
+
+    t_start = time.monotonic()
+    chat = "/v1/chat/completions"
+
+    def wire_resilience(gw) -> None:
+        manager = ResilienceManager(
+            ResilienceConfig(backoff_base_s=0.005, backoff_cap_s=0.05,
+                             failover_queue_timeout_s=2.0,
+                             breaker_failure_threshold=3),
+            metrics=gw.state.metrics, events=gw.state.events,
+            registry=gw.state.registry,
+        )
+        gw.state.resilience = manager
+        gw.state.load_manager.resilience = manager
+        gw.state.faults = FaultInjector()
+
+    async def one_stream(gw, headers, full_text) -> dict:
+        body = {"model": "m", "stream": True,
+                "messages": [{"role": "user", "content": "ping"}]}
+        buf = bytearray()
+        stamps: list[float] = []
+        resp = await gw.client.post(chat, json=body, headers=headers)
+        ok = resp.status == 200
+        async for chunk in resp.content.iter_any():
+            buf += chunk
+            stamps.append(time.perf_counter())
+        raw = bytes(buf)
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        return {
+            "ok": ok,
+            "identical": _openai_sse_text(raw) == full_text,
+            "error_frames": raw.count(b"event: error"),
+            "gaps": gaps,
+        }
+
+    # ------------------------------------------------- (a) rolling restart
+    script = list(range(100, 220))  # 120 tokens x 20 ms ≈ 2.4 s per stream
+    full_text = "".join(MockResumableEndpoint.text_of(t) for t in script)
+    gw = await GatewayHarness.create()
+    mocks = []
+    try:
+        for i in range(3):
+            mocks.append(await MockResumableEndpoint(
+                model="m", script=script, inter_chunk_delay_s=0.02).start())
+        eps = [gw.register_mock(m.url, ["m"], endpoint_type=EndpointType.TPU,
+                                name=f"eng-{i}")
+               for i, m in enumerate(mocks)]
+        wire_resilience(gw)
+        directory = gw.state.streams
+        cfg = RebalanceConfig(max_concurrent=streams, per_minute=100000,
+                              stream_window_s=0.05)
+        # the directory enforces the per-stream window itself — give it the
+        # drill's short window or a stream that already hopped once sits out
+        # the default 60 s and the next drain can never finish
+        directory.config = cfg
+        reb = Rebalancer(
+            gw.state.registry, gw.state.load_manager, directory,
+            metrics=gw.state.metrics, config=cfg,
+        )
+        headers = dict(await gw.inference_headers())
+
+        async def roll() -> dict:
+            # wait until every stream is live, then restart engines in turn
+            deadline = time.monotonic() + 5.0
+            while (len(directory._streams) < streams
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.01)
+            peak_live = len(directory._streams)
+            evacuated = []
+            for ep in eps:
+                ep.accelerator = AcceleratorInfo(accelerator="tpu",
+                                                 draining=True)
+                empty_by = time.monotonic() + 2.0
+                while time.monotonic() < empty_by:
+                    reb.tick()
+                    await asyncio.sleep(0.05)
+                    if directory.counts().get(ep.id, 0) == 0:
+                        break
+                evacuated.append(directory.counts().get(ep.id, 0) == 0)
+                # "restart": the engine comes back clean and takes load again
+                ep.accelerator = AcceleratorInfo(accelerator="tpu")
+            return {"peak_live": peak_live, "evacuated": evacuated}
+
+        roll_task = asyncio.create_task(roll())
+        outs = await asyncio.gather(
+            *(one_stream(gw, headers, full_text) for _ in range(streams)))
+        rolled = await roll_task
+        summary = gw.state.metrics.summary()
+        rolling = {
+            "streams": streams,
+            "peak_concurrent_live": rolled["peak_live"],
+            "client_success_rate": sum(o["ok"] for o in outs) / streams,
+            "token_identical_rate": (
+                sum(o["identical"] for o in outs) / streams),
+            "error_frames": sum(o["error_frames"] for o in outs),
+            "engines_fully_evacuated": sum(rolled["evacuated"]),
+            "migrations": summary["rebalance_migrations"],
+            "stream_resumes": summary["stream_resumes"],
+        }
+    finally:
+        for m in mocks:
+            await m.stop()
+        await gw.close()
+
+    # ------------------------------------------------------- (b) hot-spot
+    script = list(range(100, 180))  # 80 tokens
+    full_text = "".join(MockResumableEndpoint.text_of(t) for t in script)
+
+    async def hotspot_mode(rebalance_on: bool) -> dict:
+        gw = await GatewayHarness.create()
+        hot = cold = None
+        try:
+            hot = await MockResumableEndpoint(
+                model="m", script=script, inter_chunk_delay_s=0.05).start()
+            ep_hot = gw.register_mock(hot.url, ["m"],
+                                      endpoint_type=EndpointType.TPU,
+                                      name="hot")
+            wire_resilience(gw)
+            headers = dict(await gw.inference_headers())
+            n = max(4, streams // 2)
+            tasks = [asyncio.create_task(one_stream(gw, headers, full_text))
+                     for _ in range(n)]
+            await asyncio.sleep(0.4)  # everyone decoding on the hot engine
+            cold = await MockResumableEndpoint(
+                model="m", script=script, inter_chunk_delay_s=0.01).start()
+            ep_cold = gw.register_mock(cold.url, ["m"],
+                                       endpoint_type=EndpointType.TPU,
+                                       name="cold")
+            ep_hot.accelerator = AcceleratorInfo(
+                accelerator="tpu", num_slots=8, active_slots=8,
+                queue_depth=4)
+            ep_cold.accelerator = AcceleratorInfo(
+                accelerator="tpu", num_slots=8)
+            ticker = None
+            if rebalance_on:
+                reb = Rebalancer(
+                    gw.state.registry, gw.state.load_manager,
+                    gw.state.streams, metrics=gw.state.metrics,
+                    config=RebalanceConfig(max_concurrent=n,
+                                           per_minute=100000,
+                                           stream_window_s=0.05),
+                )
+
+                async def tick_loop():
+                    while True:
+                        reb.tick()
+                        await asyncio.sleep(0.05)
+
+                ticker = asyncio.create_task(tick_loop())
+            outs = await asyncio.gather(*tasks)
+            if ticker is not None:
+                ticker.cancel()
+                try:
+                    await ticker
+                except asyncio.CancelledError:
+                    pass
+            # steady-state ITL: the last half of each stream's gaps — the
+            # window where the planner has (or pointedly has not) acted;
+            # whole-stream p99 would be dominated by the shared slow start
+            gaps = [g for o in outs
+                    for g in o["gaps"][len(o["gaps"]) // 2:]]
+            summary = gw.state.metrics.summary()
+            return {
+                "streams": n,
+                "client_success_rate": sum(o["ok"] for o in outs) / n,
+                "token_identical_rate": sum(o["identical"] for o in outs) / n,
+                "error_frames": sum(o["error_frames"] for o in outs),
+                "itl": _gap_stats(gaps),
+                "migrations": summary["rebalance_migrations"],
+            }
+        finally:
+            for m in (hot, cold):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+
+    pinned = await hotspot_mode(False)
+    rebalanced = await hotspot_mode(True)
+    hotspot_migrations = sum(
+        n for key, n in rebalanced["migrations"].items()
+        if key == "hotspot/success")
+
+    passed = (
+        rolling["peak_concurrent_live"] >= streams
+        and rolling["client_success_rate"] == 1.0
+        and rolling["token_identical_rate"] == 1.0
+        and rolling["error_frames"] == 0
+        and rolling["engines_fully_evacuated"] == 3
+        and rolling["migrations"].get("drain/success", 0) >= streams
+        # migration is planning, not failure: nothing in stream_resumes
+        and not rolling["stream_resumes"]
+        and pinned["token_identical_rate"] == 1.0
+        and rebalanced["token_identical_rate"] == 1.0
+        and hotspot_migrations >= 1
+        and rebalanced["itl"]["p99_ms"] < pinned["itl"]["p99_ms"]
+    )
+    return {
+        "metric": "rebalance_zero_downtime_drill",
+        "unit": "fraction",
+        "value": rolling["client_success_rate"],
+        "passed": passed,
+        "rolling_restart": rolling,
+        "hotspot": {"pinned": pinned, "rebalanced": rebalanced,
+                    "itl_p99_improvement_ms": round(
+                        pinned["itl"]["p99_ms"]
+                        - rebalanced["itl"]["p99_ms"], 1)},
+        "seconds": round(time.monotonic() - t_start, 1),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--seconds", type=float, default=10.0)
@@ -3145,7 +3401,8 @@ def main() -> None:
         "--workload",
         choices=("proxy", "shared-prefix", "mixed-length", "chaos",
                  "structured", "spec-decode", "quantized", "throughput",
-                 "slo-mix", "disagg", "lora", "kv-ship", "fused"),
+                 "slo-mix", "disagg", "lora", "kv-ship", "fused",
+                 "rebalance"),
         default="proxy",
     )
     parser.add_argument("--requests", type=int, default=24,
@@ -3184,6 +3441,13 @@ def main() -> None:
             args.seconds, args.concurrency, workers_list, args.clients
         )
         print(json.dumps(result))
+        return
+    if args.workload == "rebalance":
+        result = asyncio.run(run_rebalance_bench(
+            streams=max(12, args.requests // 2)))
+        print(json.dumps(result))
+        if not result["passed"]:
+            sys.exit(1)
         return
     if args.workload not in ("proxy", "chaos"):
         _pin_platform()  # engine workloads touch jax: decide platform first

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Gossip-wire round trip for EVERY message type in MESSAGE_TYPES.
+
+The test_plan_wire / test_handoff_wire discipline applied to the gossip
+bus (gateway/gossip.py): a distinctive non-default probe value is
+synthesized for every declared dataclass field from its annotation and
+round-tripped through `encode_message` → bytes → `decode_message` — the
+ONLY paths on/off the wire — so a field added to any message kind without
+surviving serialization is a tier-1 failure (tests/test_gossip_wire.py),
+not a silently desynced fleet. Version mismatches and unknown inbound
+fields must refuse loudly (a newer peer bumps VERSION, never relies on
+silent drops). Also runnable standalone:
+
+    python scripts/check_gossip_wire.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from llmlb_tpu.gateway.gossip import (  # noqa: E402
+    MESSAGE_TYPES,
+    GossipWireError,
+    decode_message,
+    encode_message,
+)
+
+ORIGIN = "10.0.0.7:7946#w1"
+SEQ = 41
+
+
+def probe_value(cls: type, field: dataclasses.Field):
+    """A JSON-safe value distinguishable from the field's default, derived
+    from the annotation so newly added fields get covered automatically."""
+    ann = str(field.type)
+    if "dict" in ann:
+        return {"probe": field.name, "n": 3}
+    if "bool" in ann:
+        default = field.default
+        return not default if isinstance(default, bool) else True
+    if "float" in ann:
+        return 0.125
+    if "int" in ann:
+        return 7
+    if "str" in ann:
+        return f"probe-{field.name}"
+    raise AssertionError(
+        f"{cls.__name__}.{field.name}: add a wire-probe rule for {ann!r} "
+        "(and make sure the field is JSON-safe for the gossip wire)"
+    )
+
+
+def probe_data(cls: type) -> dict:
+    return {f.name: probe_value(cls, f) for f in dataclasses.fields(cls)}
+
+
+def check_roundtrip(kind: str, cls: type) -> list[str]:
+    """Round-trip every declared field; returns human-readable failures."""
+    problems: list[str] = []
+    data = probe_data(cls)
+    # probes must differ from defaults, or a dropped field that
+    # deserializes to its default would round-trip undetected
+    defaults = cls()
+    for f in dataclasses.fields(cls):
+        if data[f.name] == getattr(defaults, f.name):
+            problems.append(
+                f"{cls.__name__}.{f.name}: probe equals its default; "
+                "probe_value needs a better rule"
+            )
+    try:
+        raw = encode_message(kind, data, origin=ORIGIN, seq=SEQ, ts=1000.0)
+    except GossipWireError as e:
+        return problems + [f"{kind}: encode refused its own fields: {e}"]
+    try:
+        out_kind, out, meta = decode_message(raw)
+    except GossipWireError as e:
+        return problems + [f"{kind}: decode refused encode's output: {e}"]
+    if out_kind != kind:
+        problems.append(f"{kind}: kind changed to {out_kind!r} on the wire")
+    for f in dataclasses.fields(cls):
+        if out.get(f.name) != data[f.name]:
+            problems.append(
+                f"{cls.__name__}.{f.name} was lost or mangled on the "
+                f"gossip wire ({data[f.name]!r} -> {out.get(f.name)!r})"
+            )
+    if meta.get("origin") != ORIGIN or meta.get("seq") != SEQ:
+        problems.append(f"{kind}: envelope origin/seq mangled: {meta}")
+    if tuple(meta.get("ver") or ()) != (SEQ, ORIGIN):
+        problems.append(f"{kind}: meta['ver'] != (seq, origin): {meta}")
+    return problems
+
+
+def check_rejections(kind: str, cls: type) -> list[str]:
+    """Wrong version and unknown fields must refuse, sender- and
+    receiver-side."""
+    problems: list[str] = []
+    raw = encode_message(kind, probe_data(cls), origin=ORIGIN, seq=SEQ)
+    import json
+
+    env = json.loads(raw)
+    env["v"] = cls.VERSION + 1
+    try:
+        decode_message(json.dumps(env).encode())
+        problems.append(f"{kind}: wrong VERSION was not rejected")
+    except GossipWireError:
+        pass
+    env = json.loads(raw)
+    env["d"]["from_the_future"] = 1
+    try:
+        decode_message(json.dumps(env).encode())
+        problems.append(f"{kind}: unknown inbound field was not rejected")
+    except GossipWireError:
+        pass
+    try:
+        encode_message(kind, {"from_the_future": 1}, origin=ORIGIN, seq=SEQ)
+        problems.append(f"{kind}: encode accepted an undeclared field")
+    except GossipWireError:
+        pass
+    return problems
+
+
+def failures() -> list[str]:
+    problems: list[str] = []
+    if not MESSAGE_TYPES:
+        return ["MESSAGE_TYPES is empty — the enumeration broke"]
+    for kind, cls in sorted(MESSAGE_TYPES.items()):
+        if getattr(cls, "KIND", None) != kind:
+            problems.append(f"{cls.__name__}: KIND != registry key {kind!r}")
+        if not isinstance(getattr(cls, "VERSION", None), int):
+            problems.append(f"{cls.__name__}: VERSION must be an int")
+            continue
+        problems += check_roundtrip(kind, cls)
+        problems += check_rejections(kind, cls)
+    try:
+        encode_message("not_a_kind", {}, origin=ORIGIN, seq=1)
+        problems.append("encode accepted an unknown message kind")
+    except GossipWireError:
+        pass
+    return problems
+
+
+def main() -> int:
+    problems = failures()
+    if problems:
+        print("gossip wire-format problems:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    n_fields = sum(
+        len(dataclasses.fields(cls)) for cls in MESSAGE_TYPES.values()
+    )
+    print(f"all {len(MESSAGE_TYPES)} gossip message types "
+          f"({n_fields} fields) round-trip versioned")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,376 @@
+"""Cross-host gossip mesh: UDP/TCP transport, seq-LWW, fault injection,
+partitions, and fleet-global rate limits.
+
+The mesh tests run two real `GossipBus` instances bound to loopback UDP
+ports (separate unix directories, so ONLY the mesh can carry messages
+between them). The federation-semantics tests reuse the multi-worker
+harness (`_worker_states` pattern): shared DB, sibling unix buses — plus
+`GossipFaults` to drop/delay/partition the transport deterministically.
+"""
+
+import asyncio
+import json
+import socket
+import time
+
+import pytest
+
+from llmlb_tpu.gateway.app_state import build_app_state
+from llmlb_tpu.gateway.config import ServerConfig
+from llmlb_tpu.gateway.db import Database
+from llmlb_tpu.gateway.gossip import (
+    GossipBus,
+    GossipFaultRule,
+    GossipFaults,
+    MeshConfig,
+    UDP_MAX_BYTES,
+    encode_message,
+)
+from llmlb_tpu.gateway.resilience import BreakerState
+from llmlb_tpu.gateway.types import Endpoint, EndpointStatus
+from llmlb_tpu.gateway.worker import WorkerInfo
+
+
+def _endpoint(name: str) -> Endpoint:
+    return Endpoint(name=name, base_url=f"http://{name}:1234",
+                    status=EndpointStatus.ONLINE)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _wait_for(predicate, timeout_s: float, interval_s: float = 0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval_s)
+    return predicate()
+
+
+async def _worker_states(tmp_path, monkeypatch, n: int, *, gossip=True,
+                         port=45716):
+    monkeypatch.setenv("LLMLB_GOSSIP_DIR", str(tmp_path / "bus"))
+    monkeypatch.setenv("LLMLB_GOSSIP", "1" if gossip else "0")
+    db_path = str(tmp_path / "gw.db")
+    config = ServerConfig(port=port, database_url=db_path)
+    states = []
+    for i in range(n):
+        states.append(await build_app_state(
+            config, db=Database(db_path), start_background=False,
+            worker=WorkerInfo(index=i, count=n),
+        ))
+    return states
+
+
+# ------------------------------------------------------------- mesh transport
+
+
+async def _mesh_pair(tmp_path):
+    """Two buses on DIFFERENT unix dirs joined only by loopback UDP."""
+    pa, pb = _free_port(), _free_port()
+    addr_a, addr_b = f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"
+    bus_a = GossipBus(str(tmp_path / "host-a"), 0,
+                      mesh=MeshConfig(bind=addr_a, advertise=addr_a,
+                                      peers=(addr_b,)))
+    bus_b = GossipBus(str(tmp_path / "host-b"), 0,
+                      mesh=MeshConfig(bind=addr_b, advertise=addr_b,
+                                      peers=(addr_a,)))
+    await bus_a.start()
+    await bus_b.start()
+    return bus_a, bus_b
+
+
+async def test_mesh_udp_delivery_and_origin_identity(tmp_path):
+    """A message published on host A arrives on host B over UDP, carrying
+    a host-qualified origin so two hosts' worker-0 clocks never collide."""
+    bus_a, bus_b = await _mesh_pair(tmp_path)
+    got = []
+    try:
+        bus_b.subscribe("tps", lambda d, m: got.append((d, m)))
+        bus_a.publish("tps", {"eid": "e1", "model": "m", "kind": "chat",
+                              "ema": 120.0, "samples": 3})
+        assert await _wait_for(lambda: got, 2.0), "UDP datagram never arrived"
+        data, meta = got[0]
+        assert data["ema"] == 120.0
+        assert meta["origin"] == bus_a.origin
+        assert "#w0" in meta["origin"] and "127.0.0.1" in meta["origin"]
+        assert bus_a.origin != bus_b.origin  # same index, different host
+    finally:
+        bus_a.close()
+        bus_b.close()
+
+
+async def test_mesh_tcp_fallback_for_oversize_payloads(tmp_path):
+    """A heat map too big for one UDP datagram rides the TCP side of the
+    mesh port instead of being silently truncated or dropped."""
+    bus_a, bus_b = await _mesh_pair(tmp_path)
+    got = []
+    try:
+        bus_b.subscribe("heat", lambda d, m: got.append(d))
+        entries = {f"prefixhash-{i:05d}": float(i) for i in range(4000)}
+        payload = {"model": "m", "entries": entries}
+        assert len(json.dumps(payload).encode()) > UDP_MAX_BYTES
+        bus_a.publish("heat", payload)
+        assert await _wait_for(lambda: got, 3.0), "oversize payload lost"
+        assert got[0]["entries"] == entries
+    finally:
+        bus_a.close()
+        bus_b.close()
+
+
+async def test_mesh_stats_expose_peer_figures(tmp_path):
+    bus_a, bus_b = await _mesh_pair(tmp_path)
+    try:
+        stats = bus_a.stats()
+        for key in ("sent_total", "received_total", "recv_rejected_total",
+                    "fault_dropped_total", "mesh_peers",
+                    "partition_suspected"):
+            assert key in stats, key
+        assert bus_a.mesh_peer_count() == 1
+    finally:
+        bus_a.close()
+        bus_b.close()
+
+
+# ----------------------------------------------------------- seq-LWW ordering
+
+
+async def test_skewed_wall_clock_cannot_resurrect_breaker_state(
+    tmp_path, monkeypatch
+):
+    """Regression for the wall-stamp LWW this PR removed: a replayed OPEN
+    carrying a wall timestamp an HOUR in the future but an OLD sequence
+    number must lose to the newer CLOSED transition. Under ts-LWW it
+    would have re-ejected a healthy endpoint fleet-wide."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45716)
+    try:
+        ep = _endpoint("engine-skew")
+        s0.registry.add(ep)
+        assert await _wait_for(lambda: s1.registry.get(ep.id) is not None, 2.0)
+
+        threshold = s0.resilience.config.breaker_failure_threshold
+        for _ in range(threshold):
+            s0.resilience.record_failure(ep.id, "connect_error")
+        assert await _wait_for(
+            lambda: s1.resilience.state_of(ep.id) == BreakerState.OPEN, 1.0)
+        s0.resilience.note_probe(ep.id, True)
+        s0.resilience.on_admit(ep.id)
+        s0.resilience.record_success(ep.id)
+        assert await _wait_for(
+            lambda: s1.resilience.state_of(ep.id) == BreakerState.CLOSED, 1.0)
+
+        # the attack: an old OPEN (seq=1, long since superseded) replayed
+        # with a future wall stamp, injected straight into s1's receiver
+        stale = encode_message(
+            "breaker",
+            {"eid": ep.id, "to": "open", "reason": "stale-replay",
+             "remaining_s": 30.0},
+            origin=s0.gossip.origin, seq=1, ts=time.time() + 3600.0,
+        )
+        s1.gossip._on_datagram(stale)
+        await asyncio.sleep(0.05)
+        assert s1.resilience.state_of(ep.id) == BreakerState.CLOSED, (
+            "a stale-seq/future-ts replay resurrected an open breaker"
+        )
+        assert s1.resilience.allow(ep.id)
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# --------------------------------------------------------- fault injection
+
+
+def test_gossip_faults_env_parsing(monkeypatch):
+    monkeypatch.setenv("LLMLB_GOSSIP_FAULTS", json.dumps([
+        {"kind": "drop", "message": "tps", "probability": 1.0},
+        {"kind": "partition", "groups": [["w0"], ["w1"]]},
+    ]))
+    faults = GossipFaults.from_env()
+    assert faults is not None
+    drop, _delay = faults.decide("tps", "w0", "w1")
+    assert drop
+    monkeypatch.setenv("LLMLB_GOSSIP_FAULTS", "not json")
+    with pytest.raises(ValueError):
+        GossipFaults.from_env()
+    monkeypatch.delenv("LLMLB_GOSSIP_FAULTS")
+    assert GossipFaults.from_env() is None
+
+
+async def test_partition_no_resurrection_and_heal(tmp_path, monkeypatch):
+    """Satellite: partition the two workers mid-flight. The cut side keeps
+    converging from its OWN in-band failures (degraded, correct); healing
+    the partition must not resurrect pre-partition state — only the
+    newest transition wins — and fresh transitions flow again."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45717)
+    try:
+        ep = _endpoint("engine-part")
+        s0.registry.add(ep)
+        assert await _wait_for(lambda: s1.registry.get(ep.id) is not None, 2.0)
+
+        wall = GossipFaults([GossipFaultRule(
+            kind="partition", groups=[["w0"], ["w1"]])])
+        s0.gossip.faults = wall
+        s1.gossip.faults = wall
+
+        threshold = s0.resilience.config.breaker_failure_threshold
+        for _ in range(threshold):
+            s0.resilience.record_failure(ep.id, "connect_error")
+        assert s0.resilience.state_of(ep.id) == BreakerState.OPEN
+        await asyncio.sleep(0.1)
+        # the OPEN never crossed the wall...
+        assert s1.resilience.state_of(ep.id) == BreakerState.CLOSED
+        assert s0.gossip.stats()["fault_dropped_total"] > 0
+        # ...but the cut-off worker still converges on its own evidence
+        for _ in range(threshold):
+            s1.resilience.record_failure(ep.id, "connect_error")
+        assert not s1.resilience.allow(ep.id)
+
+        # heal; s0 recovers the endpoint — the newer CLOSED must propagate
+        wall.clear()
+        s0.resilience.note_probe(ep.id, True)
+        s0.resilience.on_admit(ep.id)
+        s0.resilience.record_success(ep.id)
+        assert s0.resilience.state_of(ep.id) == BreakerState.CLOSED
+        assert await _wait_for(
+            lambda: s1.resilience.state_of(ep.id) == BreakerState.CLOSED, 1.0
+        ), "post-heal transition did not propagate"
+
+        # and the pre-partition OPEN (older seq) can never resurrect
+        stale = encode_message(
+            "breaker",
+            {"eid": ep.id, "to": "open", "reason": "pre-partition",
+             "remaining_s": 30.0},
+            origin=s0.gossip.origin, seq=2, ts=time.time(),
+        )
+        s1.gossip._on_datagram(stale)
+        await asyncio.sleep(0.05)
+        assert s1.resilience.state_of(ep.id) == BreakerState.CLOSED
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_gossip_drop_faults_count_and_degrade(tmp_path, monkeypatch):
+    """kind=drop at probability 1.0 silently eats matching messages and
+    counts them — the sibling simply never learns (advisory state)."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45718)
+    try:
+        ep = _endpoint("engine-drop")
+        s0.registry.add(ep)
+        assert await _wait_for(lambda: s1.registry.get(ep.id) is not None, 2.0)
+        s0.gossip.faults = GossipFaults([GossipFaultRule(
+            kind="drop", message="tps", probability=1.0)])
+        from llmlb_tpu.gateway.types import TpsApiKind
+
+        s0.load_manager.update_tps(ep.id, "m", TpsApiKind.CHAT, 99, 1.0)
+        await asyncio.sleep(0.1)
+        assert s1.load_manager.get_tps(ep.id, "m", TpsApiKind.CHAT) is None
+        assert s0.gossip.stats()["fault_dropped_total"] >= 1
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+# ------------------------------------------------------ global token buckets
+
+
+async def test_global_ratelimit_admits_n_fleet_wide(tmp_path, monkeypatch):
+    """Acceptance: with gossip on, a tenant limited to burst B is admitted
+    ≈B across the whole fleet — not B×workers. Spends replicate as
+    rl_spend deltas and debit the sibling's full-limit buckets."""
+    monkeypatch.setenv("LLMLB_RATELIMIT_RPS", "0.01")  # negligible refill
+    monkeypatch.setenv("LLMLB_RATELIMIT_BURST", "8")
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45719)
+    try:
+        for s in (s0, s1):
+            snap = s.ratelimit.snapshot()
+            assert snap["global"] is True
+            assert snap["workers_divisor"] == 1
+        # 4 admissions on worker 0 (full-limit bucket: all allowed)
+        for _ in range(4):
+            assert s0.ratelimit.acquire("tenant-a").allowed
+        s0.ratelimit.flush_spends(force=True)
+        assert await _wait_for(
+            lambda: s1.ratelimit.snapshot()["remote_spends_applied"] >= 1,
+            1.0,
+        ), "rl_spend delta never reached the sibling"
+        # worker 1 sees fleet-wide consumption: exactly 4 slots remain of
+        # the 8-burst (old local-share behavior would have granted 8 more)
+        admitted = 0
+        while s1.ratelimit.acquire("tenant-a").allowed:
+            admitted += 1
+            assert admitted < 16, "sibling ignored replicated spends"
+        assert admitted == 4
+        verdict = s1.ratelimit.acquire("tenant-a")
+        assert not verdict.allowed and verdict.retry_after_s > 0
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_ratelimit_without_gossip_enforces_local_share(
+    tmp_path, monkeypatch
+):
+    """Gossip disabled: the limiter degrades to the conservative per-worker
+    share (burst/workers), never over-admitting fleet-wide."""
+    monkeypatch.setenv("LLMLB_RATELIMIT_RPS", "0.01")
+    monkeypatch.setenv("LLMLB_RATELIMIT_BURST", "8")
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, gossip=False,
+                                  port=45720)
+    try:
+        snap = s0.ratelimit.snapshot()
+        assert snap["global"] is False
+        assert snap["workers_divisor"] == 2
+        admitted = 0
+        while s0.ratelimit.acquire("tenant-b").allowed:
+            admitted += 1
+            assert admitted < 16
+        assert admitted == 4  # 8-burst split across 2 workers
+        # the sibling holds its own 4-slot share: worst case fleet-wide
+        # admission is exactly the configured burst
+        admitted1 = 0
+        while s1.ratelimit.acquire("tenant-b").allowed:
+            admitted1 += 1
+            assert admitted1 < 16
+        assert admitted1 == 4
+    finally:
+        await s0.close()
+        await s1.close()
+
+
+async def test_rebalance_directive_rides_gossip(tmp_path, monkeypatch):
+    """A migrate directive published on one worker marks eligible streams
+    in the SIBLING's directory — the primary plans, every worker moves
+    its own streams."""
+    s0, s1 = await _worker_states(tmp_path, monkeypatch, 2, port=45721)
+    try:
+        handle = s1.streams.register("rid-1", "m", "ep-hot")
+        assert handle is not None
+        ver = s0.gossip.publish("migrate", {
+            "eid": "ep-hot", "target": "ep-idle", "reason": "drain",
+            "max_streams": 2, "directive_id": 7,
+        })
+        assert await _wait_for(lambda: handle.pending is not None, 1.0), (
+            "gossiped directive never marked the sibling's stream"
+        )
+        assert handle.pending == ("ep-idle", "drain", 7)
+        # replayed datagrams must not double-apply (per-origin seq dedupe):
+        # claim, then re-inject the SAME directive — nothing re-marks
+        assert s1.streams.claim(handle) == ("ep-idle", "drain", 7)
+        raw = encode_message("migrate", {
+            "eid": "ep-hot", "target": "ep-idle", "reason": "drain",
+            "max_streams": 2, "directive_id": 7,
+        }, origin=s0.gossip.origin, seq=ver[0])
+        s1.gossip._on_datagram(raw)
+        await asyncio.sleep(0.05)
+        assert handle.pending is None
+    finally:
+        await s0.close()
+        await s1.close()

@@ -141,8 +141,8 @@ def test_affinity_entry_expires(lm):
     # age the pin past the TTL: the next lookup must treat it as a miss
     # (re-scored, re-pinned) instead of steering to a long-dead prefix
     key = ("m", h)
-    eid, ts = lm._affinity[key]
-    lm._affinity[key] = (eid, ts - PREFIX_AFFINITY_TTL_S - 1)
+    eid, ts, ver = lm._affinity[key]
+    lm._affinity[key] = (eid, ts - PREFIX_AFFINITY_TTL_S - 1, ver)
     misses_before = lm.affinity_stats()["misses_total"]
     got = lm.select_endpoint([a, b], "m", prefix_hash=h)
     assert got is not None

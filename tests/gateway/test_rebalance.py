@@ -1,0 +1,526 @@
+"""Proactive live-stream rebalancing (gateway/rebalance.py + the pump).
+
+Two layers, both tier-1:
+
+- Planner unit tests drive `Rebalancer.tick()` against fake telemetry and
+  assert the safety rails directly: hysteresis bands, the migration
+  budget, the per-stream window, drain evacuation and the SLO goodput
+  gate. No sleeps — ticks are explicit.
+- End-to-end migration tests run the real pump (GatewayHarness + two
+  MockResumableEndpoints): a directive lands mid-stream and the client
+  sees ONE uninterrupted token-identical SSE response while the stream
+  re-homes through /v1/kv/export(park) → /v1/resume. Refused targets,
+  unparkable origins, a target dying right after adoption (falls back to
+  the reactive PR 12 resume, victim booked exactly once) and
+  LLMLB_REBALANCE=0 bit-compatibility are each pinned.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+from llmlb_tpu.gateway.faults import FaultRule
+from llmlb_tpu.gateway.rebalance import (
+    RebalanceConfig,
+    Rebalancer,
+    StreamDirectory,
+)
+from llmlb_tpu.gateway.resilience import BreakerState
+from llmlb_tpu.gateway.types import AcceleratorInfo, EndpointType
+from tests.support import (
+    GatewayHarness,
+    MockResumableEndpoint,
+    assert_sse_protocol,
+)
+
+CHAT = "/v1/chat/completions"
+
+SCRIPT = list(range(100, 160))  # long enough to land a directive mid-stream
+FULL_TEXT = "".join(MockResumableEndpoint.text_of(t) for t in SCRIPT)
+
+
+# ------------------------------------------------------------ planner fakes
+
+
+class FakeEp:
+    def __init__(self, eid, *, active=0, queue=0, slots=8, draining=False):
+        self.id = eid
+        self.endpoint_type = EndpointType.TPU
+        self.accelerator = AcceleratorInfo(
+            accelerator="tpu", num_slots=slots, active_slots=active,
+            queue_depth=queue, draining=draining,
+        )
+
+
+class FakeRegistry:
+    def __init__(self, eps):
+        self.eps = eps
+
+    def list_online(self):
+        return list(self.eps)
+
+
+class FakeLoad:
+    def active_count(self, eid):
+        return 0
+
+    def tps_snapshot(self):
+        return {}
+
+
+class FakeMetrics:
+    def __init__(self, goodput=None):
+        self.goodput = goodput
+        self.calls = []
+
+    def record_rebalance_migration(self, reason, outcome):
+        self.calls.append((reason, outcome))
+
+    def summary(self):
+        return {"goodput_ratio": self.goodput}
+
+
+class FakeBus:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, kind, data, **kw):
+        self.published.append((kind, data))
+
+
+def _planner(eps, *, metrics=None, config=None, directory=None):
+    bus = FakeBus()
+    reb = Rebalancer(
+        FakeRegistry(eps), FakeLoad(),
+        directory or StreamDirectory(RebalanceConfig()),
+        metrics=metrics, gossip=bus, config=config or RebalanceConfig(),
+    )
+    return reb, bus
+
+
+# ------------------------------------------------------- planner unit tests
+
+
+def test_hotspot_needs_consecutive_hot_ticks():
+    """Hysteresis: one hot sample never moves a stream; the second
+    consecutive one does, and the directive goes out over gossip."""
+    hot = FakeEp("hot", active=8, queue=3)      # score 11/8, queue > 0
+    idle = FakeEp("idle", active=0, queue=0)    # score 0
+    reb, bus = _planner([hot, idle])
+    reb.tick()
+    assert bus.published == [] and reb.directives_total == 0
+    reb.tick()
+    assert reb.directives_total == 1
+    assert bus.published == [("migrate", {
+        "eid": "hot", "target": "idle", "reason": "hotspot",
+        "max_streams": 1, "directive_id": 1,
+    })]
+
+
+def test_hysteresis_resets_on_a_cool_tick():
+    """hot, cool, hot is NOT two consecutive hot ticks."""
+    hot = FakeEp("hot", active=8, queue=3)
+    idle = FakeEp("idle")
+    reb, bus = _planner([hot, idle])
+    reb.tick()
+    hot.accelerator = AcceleratorInfo(num_slots=8, active_slots=1)  # cools
+    reb.tick()
+    hot.accelerator = AcceleratorInfo(num_slots=8, active_slots=8,
+                                      queue_depth=3)  # hot again
+    reb.tick()
+    assert bus.published == []  # counter restarted at 1
+    reb.tick()
+    assert reb.directives_total == 1
+
+
+def test_no_migration_between_the_bands():
+    """A source above low but below high water is left alone, and so is a
+    hot source when every other engine is also above the low band."""
+    warm = FakeEp("warm", active=5, queue=0)    # 0.625: between bands
+    idle = FakeEp("idle")
+    reb, bus = _planner([warm, idle])
+    reb.tick()
+    reb.tick()
+    assert bus.published == []
+    busy = FakeEp("busy", active=8, queue=2)
+    half = FakeEp("half", active=4, queue=0)    # 0.5 > low_water 0.4
+    reb2, bus2 = _planner([busy, half])
+    reb2.tick()
+    reb2.tick()
+    assert bus2.published == []
+
+
+def test_goodput_gate_blocks_queueless_hotspots():
+    """High occupancy with an empty queue and healthy (or unknown) goodput
+    is just good utilization — no churn until SLOs measurably hurt."""
+    hot = FakeEp("hot", active=8, queue=0)
+    idle = FakeEp("idle")
+    metrics = FakeMetrics(goodput=None)
+    reb, bus = _planner([hot, idle], metrics=metrics)
+    reb.tick()
+    reb.tick()
+    reb.tick()
+    assert bus.published == []  # unknown goodput never justifies churn
+    metrics.goodput = 0.80      # now the fleet is visibly missing SLOs
+    reb.tick()
+    assert reb.directives_total == 1
+    assert bus.published[0][1]["reason"] == "hotspot"
+
+
+def test_budget_per_minute_records_skipped():
+    """Once the per-minute budget is spent, directives record `skipped`
+    instead of issuing — thrash is bounded even under sustained heat."""
+    hot = FakeEp("hot", active=8, queue=3)
+    idle = FakeEp("idle")
+    metrics = FakeMetrics()
+    cfg = RebalanceConfig(per_minute=1)
+    reb, bus = _planner([hot, idle], metrics=metrics, config=cfg)
+    reb.tick(), reb.tick()
+    assert reb.directives_total == 1
+    reb.tick(), reb.tick()  # still hot: second directive wants to issue
+    assert reb.directives_total == 1
+    assert reb.skipped_budget_total == 1
+    assert ("hotspot", "skipped") in metrics.calls
+    assert len(bus.published) == 1
+
+
+def test_budget_max_concurrent_counts_inflight():
+    """Streams already pending/migrating count against max_concurrent."""
+    directory = StreamDirectory(RebalanceConfig())
+    for i in range(2):
+        directory.register(f"r{i}", "m", "hot")
+    assert directory.apply_directive("hot", "idle", "drain", 2, 1) == 2
+    assert directory.inflight() == 2
+    hot = FakeEp("hot", active=8, queue=3)
+    idle = FakeEp("idle")
+    reb, bus = _planner([hot, idle], metrics=FakeMetrics(),
+                        config=RebalanceConfig(max_concurrent=2),
+                        directory=directory)
+    reb.tick(), reb.tick()
+    assert reb.directives_total == 0 and reb.skipped_budget_total == 1
+
+
+def test_drain_evacuation_targets_least_loaded():
+    """A draining engine gets its streams moved NOW (reason=drain), to the
+    lowest-scoring healthy engine, budget-paced."""
+    going = FakeEp("going", active=4, draining=True)
+    busy = FakeEp("busy", active=6)
+    calm = FakeEp("calm", active=1)
+    directory = StreamDirectory(RebalanceConfig())
+    handle = directory.register("r1", "m", "going")
+    reb, bus = _planner([going, busy, calm], directory=directory)
+    reb.tick()
+    assert bus.published == [("migrate", {
+        "eid": "going", "target": "calm", "reason": "drain",
+        "max_streams": 2, "directive_id": 1,
+    })]
+    assert handle.pending == ("calm", "drain", 1)
+
+
+def test_stream_window_blocks_repeat_migration():
+    """The same stream is never marked twice within stream_window_s —
+    regardless of the outcome of the first attempt."""
+    directory = StreamDirectory(RebalanceConfig(stream_window_s=60.0))
+    handle = directory.register("r1", "m", "a")
+    assert directory.apply_directive("a", "b", "hotspot", 1, 1) == 1
+    assert directory.claim(handle) == ("b", "hotspot", 1)
+    directory.note_outcome(handle, success=True, target="b")
+    assert handle.endpoint_id == "b" and handle.migrations == 1
+    assert directory.apply_directive("b", "a", "hotspot", 1, 2) == 0
+    # outside the window it becomes eligible again
+    handle.last_migrate_at = time.monotonic() - 61.0
+    assert directory.apply_directive("b", "a", "hotspot", 1, 3) == 1
+
+
+def test_directive_racing_natural_finish_dies_unclaimed():
+    """Unregister (stream finished) wins the race: a pending directive is
+    dropped, claim() returns None, nothing is accounted."""
+    directory = StreamDirectory(RebalanceConfig())
+    handle = directory.register("r1", "m", "a")
+    assert directory.apply_directive("a", "b", "drain", 4, 1) == 1
+    directory.unregister(handle)
+    assert directory.claim(handle) is None
+    assert directory.inflight() == 0
+    assert directory.snapshot()["streams"] == 0
+
+
+def test_disabled_directory_registers_nothing():
+    directory = StreamDirectory(RebalanceConfig(enabled=False))
+    assert directory.register("r1", "m", "a") is None
+    assert directory.counts() == {}
+
+
+def test_oldest_streams_evacuate_first():
+    directory = StreamDirectory(RebalanceConfig())
+    young = directory.register("young", "m", "a")
+    old = directory.register("old", "m", "a")
+    old.started_at -= 100.0
+    assert directory.apply_directive("a", "b", "drain", 1, 1) == 1
+    assert old.pending is not None and young.pending is None
+
+
+# --------------------------------------------------------- e2e: the pump
+
+
+def _chat_body():
+    return {"model": "m", "stream": True,
+            "messages": [{"role": "user", "content": "ping"}]}
+
+
+def _openai_stream_text(body: bytes) -> str:
+    parts = []
+    for line in body.split(b"\n"):
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        data = line[len(b"data:"):].strip()
+        if not data or data == b"[DONE]":
+            continue
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            continue
+        for choice in obj.get("choices") or []:
+            content = (choice.get("delta") or {}).get("content")
+            if isinstance(content, str):
+                parts.append(content)
+    return "".join(parts)
+
+
+async def _migration_pair(gw, *, delay_s=0.01):
+    """Two slow resumable tpu:// mocks + resilience, resume armed."""
+    from llmlb_tpu.gateway.config import ResilienceConfig
+    from llmlb_tpu.gateway.faults import FaultInjector
+    from llmlb_tpu.gateway.resilience import ResilienceManager
+
+    a = await MockResumableEndpoint(
+        model="m", script=SCRIPT, inter_chunk_delay_s=delay_s).start()
+    b = await MockResumableEndpoint(
+        model="m", script=SCRIPT, inter_chunk_delay_s=delay_s).start()
+    ep_a = gw.register_mock(a.url, ["m"], endpoint_type=EndpointType.TPU,
+                            name="eng-a")
+    ep_b = gw.register_mock(b.url, ["m"], endpoint_type=EndpointType.TPU,
+                            name="eng-b")
+    cfg = ResilienceConfig(backoff_base_s=0.001, backoff_cap_s=0.002,
+                           failover_queue_timeout_s=0.3,
+                           breaker_failure_threshold=3)
+    manager = ResilienceManager(cfg, metrics=gw.state.metrics,
+                                events=gw.state.events,
+                                registry=gw.state.registry)
+    gw.state.resilience = manager
+    gw.state.load_manager.resilience = manager
+    gw.state.faults = FaultInjector()
+    return a, b, ep_a, ep_b, manager
+
+
+async def _wait_for(pred, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(0.005)
+    return False
+
+
+async def _start_stream_and_directive(gw, mocks, eps, *, target_status=True):
+    """POST a streaming chat, wait until it is live with some committed
+    tokens, then issue a hotspot directive away from its origin. Returns
+    (response, origin_mock, target_mock, origin_ep, target_ep)."""
+    headers = await gw.inference_headers()
+    r = await gw.client.post(CHAT, json=_chat_body(), headers=headers)
+    assert r.status == 200, await r.text()
+    assert await _wait_for(lambda: len(gw.state.streams._streams) == 1)
+    handle = next(iter(gw.state.streams._streams.values()))
+    # let a few committed tokens accumulate before moving the stream
+    await asyncio.sleep(0.12)
+    (ep_a, ep_b), (a, b) = eps, mocks
+    origin_ep, target_ep = ((ep_a, ep_b) if handle.endpoint_id == ep_a.id
+                            else (ep_b, ep_a))
+    origin, target = (a, b) if origin_ep is ep_a else (b, a)
+    marked = gw.state.streams.apply_directive(
+        origin_ep.id, target_ep.id, "hotspot", 1, 1)
+    assert marked == 1
+    return r, origin, target, origin_ep, target_ep
+
+
+def test_proactive_migration_token_identical():
+    """The headline contract: a hotspot directive re-homes a LIVE stream
+    through park-export + resume and the client sees one uninterrupted
+    token-identical response — no error frame, no resume accounting (this
+    was planning, not failure), the origin parked exactly once."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a, b, ep_a, ep_b, manager = await _migration_pair(gw)
+            r, origin, target, origin_ep, target_ep = (
+                await _start_stream_and_directive(
+                    gw, (a, b), (ep_a, ep_b)))
+            body = await r.read()
+            assert b"event: error" not in body
+            assert_sse_protocol(body, "openai")
+            assert _openai_stream_text(body) == FULL_TEXT
+            # the origin was asked to park + export, the target to adopt
+            assert [c.get("park") for c in origin.export_calls] == [True]
+            assert len(target.resume_calls) == 1
+            committed = target.resume_calls[0]["committed_ids"]
+            assert committed == SCRIPT[:len(committed)] and committed
+            # the exported KV pages rode the resume body verbatim
+            assert target.resume_calls[0]["kv_pages"] == {
+                "mock": True, "park": True}
+            summary = gw.state.metrics.summary()
+            assert summary["rebalance_migrations"] == {"hotspot/success": 1}
+            # migration is NOT failure: no resume outcomes, no
+            # interruptions, both breakers untouched
+            assert summary["stream_resumes"] == {}
+            assert summary["stream_interruptions_total"] == 0
+            assert manager.state_of(origin_ep.id) == BreakerState.CLOSED
+            assert manager.state_of(target_ep.id) == BreakerState.CLOSED
+            # the stream finished and unregistered cleanly
+            assert gw.state.streams.snapshot()["streams"] == 0
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_target_refuses_stream_stays_on_origin():
+    """A target that rejects the adopt aborts the migration instantly and
+    invisibly: the SAME origin connection keeps streaming, outcome is
+    `refused`, nobody's breaker or failure ledger moves."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a, b, ep_a, ep_b, manager = await _migration_pair(gw)
+            a.resume_fail_with = 503
+            b.resume_fail_with = 503
+            r, origin, target, origin_ep, target_ep = (
+                await _start_stream_and_directive(
+                    gw, (a, b), (ep_a, ep_b)))
+            body = await r.read()
+            assert b"event: error" not in body
+            assert _openai_stream_text(body) == FULL_TEXT
+            summary = gw.state.metrics.summary()
+            assert summary["rebalance_migrations"] == {"hotspot/refused": 1}
+            assert summary["stream_resumes"] == {}
+            outcomes = gw.state.load_manager.endpoint_outcomes()
+            assert outcomes.get(target_ep.id, {}).get("failures", 0) == 0
+            assert manager.state_of(target_ep.id) == BreakerState.CLOSED
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_origin_unparkable_aborts_untouched():
+    """If the origin cannot export (old build, park refused), the
+    migration aborts before the target is ever contacted."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a, b, ep_a, ep_b, manager = await _migration_pair(gw)
+            a.export_fail_with = 404
+            b.export_fail_with = 404
+            r, origin, target, origin_ep, target_ep = (
+                await _start_stream_and_directive(
+                    gw, (a, b), (ep_a, ep_b)))
+            body = await r.read()
+            assert b"event: error" not in body
+            assert _openai_stream_text(body) == FULL_TEXT
+            assert target.resume_calls == []
+            summary = gw.state.metrics.summary()
+            assert summary["rebalance_migrations"] == {"hotspot/aborted": 1}
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_target_dies_after_adopt_falls_back_to_reactive_resume():
+    """The adopting engine dies mid-stream AFTER a successful migration:
+    the reactive resume path (PR 12) takes over, books the victim (the
+    migration target) exactly once, and the client still gets the full
+    token-identical text."""
+    async def run():
+        gw = await GatewayHarness.create()
+        a = b = None
+        try:
+            a, b, ep_a, ep_b, manager = await _migration_pair(gw)
+            # cut the FIRST /v1/resume response (the migration adopt)
+            # after a few frames; the reactive re-resume is the second
+            # /v1/resume stream and is left alone (max_fires=1)
+            gw.state.faults.add_rule(FaultRule(
+                kind="engine_abort", endpoint="*", path="resume",
+                after_bytes=600, max_fires=1,
+            ))
+            r, origin, target, origin_ep, target_ep = (
+                await _start_stream_and_directive(
+                    gw, (a, b), (ep_a, ep_b)))
+            body = await r.read()
+            assert b"event: error" not in body
+            assert_sse_protocol(body, "openai")
+            assert _openai_stream_text(body) == FULL_TEXT
+            summary = gw.state.metrics.summary()
+            assert summary["rebalance_migrations"] == {"hotspot/success": 1}
+            # the reactive path fired once, against the migration target
+            assert summary["stream_resumes"] == {"success": 1}
+            outcomes = gw.state.load_manager.endpoint_outcomes()
+            to = outcomes[target_ep.id]
+            assert to["stream_interruptions"] == 1
+            assert to["failures"] == 1
+            # the origin was never booked for the planned hand-off
+            oo = outcomes[origin_ep.id]
+            assert oo.get("stream_interruptions", 0) == 0
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())
+
+
+def test_rebalance_disabled_is_bit_compatible():
+    """LLMLB_REBALANCE=0: streams never register with the directory, a
+    directive marks nothing, and the stream is byte-identical to the
+    pre-rebalancer gateway."""
+    async def run():
+        os.environ["LLMLB_REBALANCE"] = "0"
+        try:
+            gw = await GatewayHarness.create()
+        finally:
+            del os.environ["LLMLB_REBALANCE"]
+        a = b = None
+        try:
+            a, b, ep_a, ep_b, manager = await _migration_pair(gw)
+            assert gw.state.streams.register("x", "m", ep_a.id) is None
+            headers = await gw.inference_headers()
+            r = await gw.client.post(CHAT, json=_chat_body(),
+                                     headers=headers)
+            assert r.status == 200
+            await asyncio.sleep(0.05)
+            assert gw.state.streams._streams == {}
+            assert gw.state.streams.apply_directive(
+                ep_a.id, ep_b.id, "hotspot", 1, 1) == 0
+            assert gw.state.streams.apply_directive(
+                ep_b.id, ep_a.id, "hotspot", 1, 1) == 0
+            body = await r.read()
+            assert b"event: error" not in body
+            assert _openai_stream_text(body) == FULL_TEXT
+            summary = gw.state.metrics.summary()
+            assert summary["rebalance_migrations"] == {}
+            assert a.export_calls == [] and b.export_calls == []
+        finally:
+            for m in (a, b):
+                if m is not None:
+                    await m.stop()
+            await gw.close()
+    asyncio.run(run())

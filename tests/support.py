@@ -332,6 +332,11 @@ class MockResumableEndpoint(MockOpenAIEndpoint):
         self.tokens_per_chunk = max(1, tokens_per_chunk)
         self.resume_fail_with = resume_fail_with
         self.resume_calls: list[dict] = []
+        # /v1/kv/export behavior (proactive migration tests): None = serve
+        # an opaque kv_pages payload; an int = refuse with that status
+        # (an origin that cannot park right now, or an old build 404ing)
+        self.export_fail_with: int | None = None
+        self.export_calls: list[dict] = []
         # graceful-drain advertisement (flip from tests; the gateway's
         # health probe re-parses it every cycle)
         self.draining = False
@@ -347,6 +352,7 @@ class MockResumableEndpoint(MockOpenAIEndpoint):
         app.router.add_get("/api/health", self._health)
         app.router.add_post("/v1/chat/completions", self._chat)
         app.router.add_post("/v1/resume", self._resume)
+        app.router.add_post("/v1/kv/export", self._kv_export)
         self.server = TestServer(app)
         await self.server.start_server()
         return self
@@ -415,6 +421,19 @@ class MockResumableEndpoint(MockOpenAIEndpoint):
         if not body.get("stream"):
             return await super()._chat(request)
         return await self._stream_script(request, body, 0)
+
+    async def _kv_export(self, request):
+        body = await request.json()
+        self.export_calls.append(body)
+        if self.export_fail_with:
+            return web.json_response({"error": "induced"},
+                                     status=self.export_fail_with)
+        # opaque payload: the gateway forwards it verbatim to /v1/resume
+        # (a real engine would refuse a mismatched payload and replay)
+        return web.json_response({
+            "request_id": body.get("request_id"),
+            "kv_pages": {"mock": True, "park": bool(body.get("park"))},
+        })
 
     async def _resume(self, request):
         body = await request.json()

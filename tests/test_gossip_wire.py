@@ -1,0 +1,71 @@
+"""Tier-1 guard: every gossip message type survives its own wire.
+
+Runs scripts/check_gossip_wire.py in-process (the test_env_docs pattern):
+every dataclass in gateway/gossip.py MESSAGE_TYPES gets a non-default
+probe per declared field, round-tripped through encode_message →
+decode_message; version mismatches and unknown fields must refuse. A
+field added without wire coverage fails here, not in a mixed fleet.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import check_gossip_wire  # noqa: E402
+
+from llmlb_tpu.gateway.gossip import (  # noqa: E402
+    MESSAGE_TYPES,
+    GossipWireError,
+    decode_message,
+    encode_message,
+)
+
+
+def test_every_message_type_roundtrips_versioned():
+    problems = check_gossip_wire.failures()
+    assert not problems, "\n".join(problems)
+
+
+def test_enumeration_is_not_vacuous():
+    """The registry must contain the well-known kinds (no silent pass if
+    the MESSAGE_TYPES comprehension breaks)."""
+    for kind in ("hello", "tps", "breaker", "rl_spend", "heat", "migrate",
+                 "residency"):
+        assert kind in MESSAGE_TYPES, kind
+
+
+@pytest.mark.parametrize("kind", sorted(MESSAGE_TYPES))
+def test_per_kind_roundtrip(kind):
+    """Per-kind failure granularity on top of the aggregate check."""
+    cls = MESSAGE_TYPES[kind]
+    assert not check_gossip_wire.check_roundtrip(kind, cls)
+    assert not check_gossip_wire.check_rejections(kind, cls)
+
+
+def test_checker_catches_a_lost_field(monkeypatch):
+    """The checker itself must fail when a field does not survive."""
+
+    def lossy_decode(raw):
+        k, data, meta = decode_message(raw)
+        data.pop(next(iter(sorted(data)), None), None)
+        return k, data, meta
+
+    monkeypatch.setattr(check_gossip_wire, "decode_message", lossy_decode)
+    kind = "migrate"
+    assert check_gossip_wire.check_roundtrip(kind, MESSAGE_TYPES[kind])
+
+
+def test_decode_rejects_garbage():
+    for raw in (b"not json", b"[1,2]", b'{"k": 7}'):
+        with pytest.raises(GossipWireError):
+            decode_message(raw)
+
+
+def test_meta_version_is_seq_origin():
+    raw = encode_message("tps_clear", {"eid": "e1"}, origin="hostA#w0",
+                         seq=9)
+    _, _, meta = decode_message(raw)
+    assert meta["ver"] == (9, "hostA#w0")
